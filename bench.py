@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Headline benchmark: TinyGPT tier-A tokens/sec/chip on real hardware.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference's best published per-GPU throughput — DeepSpeed
+ZeRO-2 on 4x A10 at 18,147 tokens/sec total = 4,536.75 tokens/sec/GPU
+(reference README.md:221, BASELINE.md), at the same parity config:
+tier A (~236M params), seq_len 2048, per-device batch 1, grad-accum 4,
+100 steps with 5 warmup steps excluded.
+"""
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_BEST_TOKENS_PER_SEC_PER_GPU = 18147.0 / 4  # ZeRO-2, 4x A10
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--strategy", default="zero2")
+    p.add_argument("--tier", default="A")
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--warmup-steps", type=int, default=5)
+    p.add_argument("--per-device-batch", type=int, default=1)
+    p.add_argument("--grad-accum", type=int, default=4)
+    p.add_argument("--world-size", type=int, default=None,
+                   help="default: all visible devices")
+    args = p.parse_args()
+
+    from distributed_llm_training_benchmark_framework_tpu.utils.platform import (
+        honor_jax_platforms_env,
+    )
+
+    honor_jax_platforms_env()
+
+    import jax
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel import get_strategy
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import run_benchmark
+
+    world = args.world_size or jax.device_count()
+
+    # Keep stdout clean for the single JSON line; progress goes to stderr.
+    with contextlib.redirect_stdout(sys.stderr):
+        result = run_benchmark(
+            strategy=get_strategy(args.strategy),
+            tier=args.tier,
+            seq_len=args.seq_len,
+            steps=args.steps,
+            warmup_steps=args.warmup_steps,
+            per_device_batch=args.per_device_batch,
+            grad_accum=args.grad_accum,
+            world_size=world,
+            results_dir=None,
+        )
+
+    per_chip = result.tokens_per_sec / world
+    print(json.dumps({
+        "metric": "tinygpt_tierA_seq2048_tokens_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_BEST_TOKENS_PER_SEC_PER_GPU, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Benchmark harness entry point (layout parity with the reference's
+``benchmarking/train_harness.py``; implementation lives in the
+``distributed_llm_training_benchmark_framework_tpu`` package).
+
+Run e.g.:
+
+    python -u benchmarking/train_harness.py \
+        --strategy ddp --world-size 1 --rank 0 \
+        --tier S --seq-len 128 --steps 20 --warmup-steps 2 \
+        --per-device-batch 1 --grad-accum 1 --results-dir ./results
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_llm_training_benchmark_framework_tpu.train.harness import main
+
+if __name__ == "__main__":
+    sys.exit(main())

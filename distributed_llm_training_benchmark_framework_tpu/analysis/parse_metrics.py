@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Aggregate result.json files into metrics.csv with scaling efficiency.
+
+Contract parity with the reference aggregator (``scripts/parse_metrics.py``):
+
+- discovers results by recursive glob for ``result*.json`` under
+  ``--results-dir`` (reference ``parse_metrics.py:21``);
+- emits ``metrics.csv`` whose leading columns are exactly the reference's
+  (sample: ``results/example_output/README.md:85-92``), with
+  ``scaling_efficiency_pct`` last; TPU-additive columns sit in between and
+  name-based consumers are unaffected;
+- scaling efficiency uses the *same formula* (reference
+  ``parse_metrics.py:50-63``): for each (strategy, seq_len) group the baseline
+  is the row with minimum world_size, and
+
+      efficiency_pct = tokens_per_sec / (baseline_tps * world_size) * 100
+
+  which pins baseline-world-size rows at ``100/baseline_ws`` % — with the
+  reference's 2-GPU-minimum data that produced the "50% at 2 GPU" quirk; our
+  suites include world_size=1 rows so the baseline is a true single-chip run
+  and the numbers become honest automatically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from pathlib import Path
+from typing import List
+
+import pandas as pd
+
+REFERENCE_COLUMNS = [
+    "strategy", "world_size", "rank", "seq_len", "tier", "steps",
+    "per_device_batch", "grad_accum", "tokens_per_sec", "mean_step_time_sec",
+    "mean_loss", "peak_vram_gb", "h2d_gbps_per_gpu",
+]
+
+
+def load_results(results_dir: str) -> pd.DataFrame:
+    rows = []
+    for path in sorted(Path(results_dir).rglob("result*.json")):
+        try:
+            with open(path) as f:
+                rows.append(json.load(f))
+        except (json.JSONDecodeError, OSError) as e:
+            print(f"WARNING: skipping unreadable {path}: {e}")
+    if not rows:
+        raise SystemExit(f"No result*.json files found under {results_dir}")
+    df = pd.DataFrame(rows)
+    # The same run can surface twice: the harness writes result_<arm>.json and
+    # the log scraper extracts result.json for the identical run. Dedupe on
+    # the run identity key.
+    key = [
+        c for c in (
+            "strategy", "world_size", "seq_len", "tier", "rank",
+            "per_device_batch", "grad_accum", "steps", "attention_impl",
+        ) if c in df.columns
+    ]
+    df = df.drop_duplicates(subset=key, keep="first")
+    return df.sort_values(["strategy", "seq_len", "world_size"]).reset_index(drop=True)
+
+
+def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
+    """Reference formula (parse_metrics.py:50-63), reproduced exactly.
+
+    Grouping extends the reference's (strategy, seq_len) with every other
+    config axis we preserve through dedup (attention_impl, batch shape, ...),
+    so a row's baseline always ran the identical configuration at the smallest
+    world size — never a different kernel's throughput.
+    """
+    group_cols = ["strategy", "seq_len"] + [
+        c for c in ("tier", "per_device_batch", "grad_accum", "attention_impl")
+        if c in df.columns
+    ]
+    df = df.copy()
+    df["scaling_efficiency_pct"] = 0.0
+    for _, group in df.groupby(group_cols):
+        base = group.loc[group["world_size"].idxmin()]
+        for i in group.index:
+            row = df.loc[i]
+            denom = base["tokens_per_sec"] * row["world_size"]
+            df.loc[i, "scaling_efficiency_pct"] = (
+                row["tokens_per_sec"] / denom * 100.0 if denom > 0 else 0.0
+            )
+    return df
+
+
+def to_csv(df: pd.DataFrame, out_path: str) -> None:
+    extras = [
+        c for c in df.columns
+        if c not in REFERENCE_COLUMNS + ["scaling_efficiency_pct"]
+    ]
+    cols = [c for c in REFERENCE_COLUMNS if c in df.columns] + extras + [
+        "scaling_efficiency_pct"
+    ]
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    df[cols].to_csv(out_path, index=False)
+
+
+def main(argv: List[str] | None = None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--results-dir", required=True)
+    p.add_argument("--out", required=True, help="output directory for metrics.csv")
+    args = p.parse_args(argv)
+
+    df = add_scaling_efficiency(load_results(args.results_dir))
+    out_csv = os.path.join(args.out, "metrics.csv")
+    to_csv(df, out_csv)
+
+    print(f"Parsed {len(df)} results -> {out_csv}")
+    summary_cols = [
+        "strategy", "world_size", "seq_len", "tokens_per_sec",
+        "mean_step_time_sec", "peak_vram_gb", "scaling_efficiency_pct",
+    ]
+    print(df[[c for c in summary_cols if c in df.columns]].to_string(index=False))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Plot metrics.csv into the reference's five benchmark figures.
+
+Figure-for-figure parity with the reference plotter (``scripts/plot.py``):
+tokens/sec vs chips, step-time vs chips, peak memory vs seq-len (only when
+multiple seq-lens exist), scaling efficiency vs chips with the ideal line, and
+the H2D-proxy vs chips — one line per strategy, 150-dpi PNGs, Agg backend.
+
+Styling follows a validated colorblind-safe categorical palette (fixed slot
+order per strategy, never cycled; worst adjacent CVD deltaE 9.1), thin marks,
+recessive grid, direct axis labels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import pandas as pd  # noqa: E402
+
+# Fixed categorical slot order (validated palette; strategy -> slot, stable
+# across filtered subsets so a missing arm never repaints the survivors).
+STRATEGY_COLORS = {
+    "ddp": "#2a78d6",    # blue
+    "fsdp": "#eb6834",   # orange
+    "zero2": "#1baf7a",  # aqua
+    "zero3": "#eda100",  # yellow
+}
+FALLBACK_COLORS = ["#e87ba4", "#008300", "#4a3aa7", "#e34948"]
+
+SURFACE = "#fcfcfb"
+TEXT = "#0b0b0b"
+TEXT_2 = "#52514e"
+GRID = "#d9d8d4"
+
+
+def _style_axes(ax, xlabel: str, ylabel: str, title: str) -> None:
+    ax.set_facecolor(SURFACE)
+    ax.set_xlabel(xlabel, color=TEXT)
+    ax.set_ylabel(ylabel, color=TEXT)
+    ax.set_title(title, color=TEXT, fontsize=12)
+    ax.grid(True, color=GRID, linewidth=0.6, alpha=0.8)
+    ax.tick_params(colors=TEXT_2)
+    for s in ax.spines.values():
+        s.set_color(GRID)
+
+
+def _color_for(strategy: str, i: int) -> str:
+    return STRATEGY_COLORS.get(strategy, FALLBACK_COLORS[i % len(FALLBACK_COLORS)])
+
+
+def _line_per_strategy(df: pd.DataFrame, x: str, y: str, ax) -> None:
+    for i, (strategy, g) in enumerate(sorted(df.groupby("strategy"))):
+        g = g.sort_values(x)
+        ax.plot(
+            g[x], g[y],
+            label=strategy, color=_color_for(strategy, i),
+            linewidth=2, marker="o", markersize=6,
+        )
+    ax.legend(frameon=False, labelcolor=TEXT)
+
+
+def _save(fig, out_dir: str, name: str, names: List[str]) -> None:
+    path = os.path.join(out_dir, name)
+    fig.patch.set_facecolor(SURFACE)
+    fig.tight_layout()
+    fig.savefig(path, dpi=150)
+    plt.close(fig)
+    names.append(name)
+    print(f"Wrote {path}")
+
+
+def make_plots(df: pd.DataFrame, out_dir: str) -> List[str]:
+    os.makedirs(out_dir, exist_ok=True)
+    written: List[str] = []
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    _line_per_strategy(df, "world_size", "tokens_per_sec", ax)
+    _style_axes(ax, "Chips", "Tokens/sec", "Throughput vs chip count")
+    _save(fig, out_dir, "tokens_per_sec_vs_gpu.png", written)
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    _line_per_strategy(df, "world_size", "mean_step_time_sec", ax)
+    _style_axes(ax, "Chips", "Mean step time (s)", "Step time vs chip count")
+    _save(fig, out_dir, "step_time_vs_gpu.png", written)
+
+    if df["seq_len"].nunique() > 1:
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        for i, (strategy, g) in enumerate(sorted(df.groupby("strategy"))):
+            g = g.sort_values("seq_len")
+            ax.plot(
+                g["seq_len"], g["peak_vram_gb"],
+                label=strategy, color=_color_for(strategy, i),
+                linewidth=2, marker="o", markersize=6,
+            )
+        ax.legend(frameon=False, labelcolor=TEXT)
+        _style_axes(ax, "Sequence length", "Peak HBM (GB)", "Peak memory vs sequence length")
+        _save(fig, out_dir, "vram_vs_seqlen.png", written)
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    _line_per_strategy(df, "world_size", "scaling_efficiency_pct", ax)
+    xs = sorted(df["world_size"].unique())
+    ax.plot(xs, [100.0] * len(xs), linestyle="--", color=TEXT_2, linewidth=1.5,
+            label="ideal (100%)")
+    ax.legend(frameon=False, labelcolor=TEXT)
+    _style_axes(ax, "Chips", "Scaling efficiency (%)", "Scaling efficiency vs chip count")
+    _save(fig, out_dir, "scaling_efficiency.png", written)
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    _line_per_strategy(df, "world_size", "h2d_gbps_per_gpu", ax)
+    _style_axes(ax, "Chips", "H2D GB/s per chip (proxy)", "Host-to-device transfer proxy")
+    _save(fig, out_dir, "gbps_vs_gpu.png", written)
+
+    return written
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--results", required=True, help="path to metrics.csv")
+    p.add_argument("--out", required=True, help="output directory for PNGs")
+    args = p.parse_args(argv)
+    df = pd.read_csv(args.results)
+    make_plots(df, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,3 @@
+from .synthetic import SyntheticDataset
+
+__all__ = ["SyntheticDataset"]

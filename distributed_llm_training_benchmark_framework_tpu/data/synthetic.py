@@ -1,0 +1,66 @@
+"""Seeded synthetic token dataset — zero I/O, fully reproducible.
+
+Capability parity with the reference ``SyntheticDataset`` (reference
+``benchmarking/train_harness.py:138-150``): a pre-materialized
+``(size, seq_len)`` integer tensor drawn uniformly from the vocabulary with a
+fixed seed (42), so every rank and every run sees identical data and the
+benchmark measures compute/communication, never input pipeline.
+
+Reference-parity semantics preserved:
+- targets are the inputs themselves, NOT shifted (reference
+  ``train_harness.py:359`` clones the batch as targets);
+- default size=1000 samples, seed=42.
+
+TPU-native differences:
+- the table is a device-resident ``jnp`` array produced by
+  ``jax.random.randint`` (threefry) — values differ from torch's generator,
+  which is irrelevant for a synthetic benchmark; determinism is what matters;
+- batching is a pure function of the step index (``batch_for_step``) instead
+  of a stateful DataLoader + DistributedSampler: the *global* batch for step i
+  is a deterministic slice, and sharding across devices/hosts is done by the
+  strategy's batch PartitionSpec, not by a sampler object.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SyntheticDataset:
+    def __init__(
+        self,
+        vocab_size: int = 32000,
+        seq_len: int = 2048,
+        size: int = 1000,
+        seed: int = 42,
+    ):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.size = size
+        self.seed = seed
+        # Materialize on host (numpy) so dataset construction never touches a
+        # device; slices are shipped per-step (and sharded by the strategy).
+        key = jax.random.key(seed)
+        self.data = np.asarray(
+            jax.random.randint(
+                key, (size, seq_len), 0, vocab_size, dtype=jnp.int32
+            )
+        )
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        return self.data[idx]
+
+    def batch_for_step(self, step: int, global_batch: int) -> np.ndarray:
+        """Deterministic global batch for a step, wrapping around the table.
+
+        Every process computes the same slice; device placement/sharding is the
+        caller's job (jax.device_put with the strategy's batch sharding).
+        """
+        start = (step * global_batch) % self.size
+        idx = (start + np.arange(global_batch)) % self.size
+        return self.data[idx]

@@ -1,0 +1,19 @@
+from .tinygpt import (
+    TinyGPTConfig,
+    get_model_config,
+    init_params,
+    forward,
+    loss_fn,
+    count_params,
+    PARAM_AXIS_RULES,
+)
+
+__all__ = [
+    "TinyGPTConfig",
+    "get_model_config",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "count_params",
+    "PARAM_AXIS_RULES",
+]

@@ -1,0 +1,356 @@
+"""TinyGPT — decoder-style benchmark transformer, pure functional JAX.
+
+Capability parity with the reference model (reference
+``benchmarking/train_harness.py:36-131``, classes ``TinyGPT`` /
+``TransformerBlock``): token embedding + learned positional embedding +
+embedding dropout + N pre-LN blocks (multi-head attention + 4x GELU MLP, both
+with residuals) + final LayerNorm + weight-tied LM head + cross-entropy loss
+with ``ignore_index=-1``.
+
+TPU-first design differences (deliberate, not omissions):
+
+- **Functional, pytree params.** No module objects. Parameters are a nested
+  dict of arrays so every leaf can carry a ``jax.sharding.NamedSharding`` —
+  strategies are data (PartitionSpecs), not wrapper classes.
+- **Stacked layers + ``lax.scan``.** All N blocks' weights are stacked on a
+  leading ``layers`` axis and the forward scans over them. One trace/compile of
+  the block regardless of depth — compile time stays flat from tier S to
+  tier B, and ``jax.checkpoint`` (remat) applies uniformly per-layer.
+- **Mixed precision the TPU way.** Params live in fp32; matmuls run in
+  bfloat16 on the MXU with fp32 accumulation (``preferred_element_type``);
+  LayerNorm, softmax and the loss stay fp32. (The reference runs fp16 AMP for
+  DDP/FSDP and bf16 for ZeRO — reference ``train_harness.py:334-335`` vs
+  ``configs/deepspeed/zero2.json:7-9``; on TPU bf16 is the native fast path.)
+- **Attention is maskless by default** for benchmark parity: the reference
+  passes no causal mask (reference ``train_harness.py:127``), so it benchmarks
+  bidirectional attention compute. ``causal=True`` is available as a real
+  option, as is a Pallas flash-attention kernel (``ops.flash_attention``).
+
+Tier table matches reference ``get_model_config`` (``train_harness.py:157-179``):
+tier A = 1024d/16h/16L (~236M params with tied embeddings), tier B =
+2048d/32h/32L (~1.68B). Tier S is ours, for CPU tests/smoke runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TinyGPTConfig:
+    vocab_size: int = 32000
+    n_embd: int = 768
+    n_head: int = 12
+    n_layer: int = 12
+    block_size: int = 4096
+    dropout: float = 0.1
+    # Parity default: the reference applies no causal mask (train_harness.py:127).
+    causal: bool = False
+    # 'reference' = jnp softmax attention; 'flash' = Pallas TPU kernel;
+    # 'ring' = ring attention over a sequence-parallel mesh axis.
+    attention_impl: str = "reference"
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    # Per-layer rematerialization (activation checkpointing) inside the scan.
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        assert self.n_embd % self.n_head == 0
+        return self.n_embd // self.n_head
+
+
+def get_model_config(tier: str, seq_len: int, **overrides) -> TinyGPTConfig:
+    """Model tier table (parity: reference train_harness.py:157-179).
+
+    block_size = seq_len exactly as the reference sets it (:168, :176), so the
+    positional table is sized to the benchmarked sequence.
+    """
+    tiers = {
+        # ~236M params (tied embeddings) — the tier all published numbers used.
+        "A": dict(vocab_size=32000, n_embd=1024, n_head=16, n_layer=16),
+        # ~1.68B params — stress tier.
+        "B": dict(vocab_size=32000, n_embd=2048, n_head=32, n_layer=32),
+        # Ours: tiny tier for CPU tests / CI smoke. Not in the reference.
+        "S": dict(vocab_size=512, n_embd=128, n_head=4, n_layer=2),
+    }
+    if tier not in tiers:
+        raise ValueError(f"Unknown tier: {tier!r} (expected one of {sorted(tiers)})")
+    kw = dict(tiers[tier])
+    kw["block_size"] = seq_len
+    kw.update(overrides)
+    return TinyGPTConfig(**kw)
+
+
+# Logical axis names for every parameter leaf, used by parallel.strategies to
+# turn a strategy into per-leaf PartitionSpecs. Leaves under 'blocks' carry a
+# leading 'layers' axis (the scan axis).
+PARAM_AXIS_RULES: Dict[str, Tuple[Optional[str], ...]] = {
+    "wte": ("vocab", "embed"),
+    "wpe": ("pos", "embed"),
+    "blocks/ln1_scale": ("layers", "embed"),
+    "blocks/ln1_bias": ("layers", "embed"),
+    "blocks/wqkv": ("layers", "embed", "qkv"),
+    "blocks/bqkv": ("layers", "qkv"),
+    "blocks/wo": ("layers", "heads_merged", "embed"),
+    "blocks/bo": ("layers", "embed"),
+    "blocks/ln2_scale": ("layers", "embed"),
+    "blocks/ln2_bias": ("layers", "embed"),
+    "blocks/wfc": ("layers", "embed", "mlp"),
+    "blocks/bfc": ("layers", "mlp"),
+    "blocks/wproj": ("layers", "mlp", "embed"),
+    "blocks/bproj": ("layers", "embed"),
+    "lnf_scale": ("embed",),
+    "lnf_bias": ("embed",),
+}
+
+
+def init_params(config: TinyGPTConfig, key: jax.Array) -> Params:
+    """Initialize the parameter pytree.
+
+    Init scheme parity (reference ``_init_weights``, train_harness.py:69-80):
+    normal(0, 0.02) for linear/embedding weights, zeros for biases, ones/zeros
+    for LayerNorm scale/bias. The LM head is weight-tied to ``wte`` (reference
+    ``train_harness.py:61-62``) — there is no separate head matrix at all.
+    """
+    c = config
+    D, H, L, V, T = c.n_embd, c.n_head, c.n_layer, c.vocab_size, c.block_size
+    k = iter(jax.random.split(key, 8))
+
+    def normal(key, shape):
+        return (0.02 * jax.random.normal(key, shape)).astype(c.param_dtype)
+
+    zeros = lambda shape: jnp.zeros(shape, c.param_dtype)
+    ones = lambda shape: jnp.ones(shape, c.param_dtype)
+
+    return {
+        "wte": normal(next(k), (V, D)),
+        "wpe": normal(next(k), (T, D)),
+        "blocks": {
+            "ln1_scale": ones((L, D)),
+            "ln1_bias": zeros((L, D)),
+            "wqkv": normal(next(k), (L, D, 3 * D)),
+            "bqkv": zeros((L, 3 * D)),
+            "wo": normal(next(k), (L, D, D)),
+            "bo": zeros((L, D)),
+            "ln2_scale": ones((L, D)),
+            "ln2_bias": zeros((L, D)),
+            "wfc": normal(next(k), (L, D, 4 * D)),
+            "bfc": zeros((L, 4 * D)),
+            "wproj": normal(next(k), (L, 4 * D, D)),
+            "bproj": zeros((L, D)),
+        },
+        "lnf_scale": ones((D,)),
+        "lnf_bias": zeros((D,)),
+    }
+
+
+def count_params(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+
+def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e-5) -> jax.Array:
+    # fp32 statistics regardless of compute dtype (AMP-style numerics).
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _dropout(x: jax.Array, rate: float, key: Optional[jax.Array], deterministic: bool) -> jax.Array:
+    if deterministic or rate == 0.0 or key is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros((), x.dtype)).astype(x.dtype)
+
+
+def _attention(
+    config: TinyGPTConfig,
+    q: jax.Array,  # (B, S, H, Dh)
+    k: jax.Array,
+    v: jax.Array,
+    dropout_key: Optional[jax.Array],
+    deterministic: bool,
+) -> jax.Array:
+    """Dispatch to the configured attention implementation. Returns (B,S,H,Dh).
+
+    Note: the flash and ring kernels do not apply attention-probability
+    dropout (embedding/MLP dropout still applies) — the probabilities never
+    materialize, which is the point of those kernels. The harness prints this
+    deviation when benchmarking with dropout > 0, and cross-impl comparisons
+    should set dropout=0 for exact parity.
+    """
+    if config.attention_impl == "flash":
+        # Pallas TPU kernel; fp32 online-softmax accumulation internally.
+        from ..ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=config.causal)
+    if config.attention_impl == "ring":
+        from ..ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=config.causal)
+
+    # Reference jnp implementation: softmax(QK^T/sqrt(d))V with fp32 softmax.
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if config.causal:
+        s = q.shape[1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # Parity: nn.MultiheadAttention applies dropout to attention probabilities
+    # (reference train_harness.py:116).
+    probs = _dropout(probs, config.dropout, dropout_key, deterministic)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(q.dtype), v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype)
+
+
+def _block(
+    config: TinyGPTConfig,
+    x: jax.Array,  # (B, S, D) compute dtype
+    layer: Params,  # one layer's slice of the stacked block params
+    dropout_key: Optional[jax.Array],
+    deterministic: bool,
+) -> jax.Array:
+    """Pre-LN transformer block (parity: reference train_harness.py:108-131)."""
+    c = config
+    B, S, D = x.shape
+    cd = c.compute_dtype
+    keys = (
+        jax.random.split(dropout_key, 2) if dropout_key is not None else (None, None)
+    )
+
+    # --- attention sublayer ---
+    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    qkv = (
+        jnp.einsum("bsd,de->bse", h, layer["wqkv"].astype(cd), preferred_element_type=jnp.float32)
+        .astype(cd)
+        + layer["bqkv"].astype(cd)
+    )
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    to_heads = lambda t: t.reshape(B, S, c.n_head, c.head_dim)
+    attn = _attention(c, to_heads(q), to_heads(k), to_heads(v), keys[0], deterministic)
+    attn = attn.reshape(B, S, D)
+    attn = (
+        jnp.einsum("bsd,de->bse", attn, layer["wo"].astype(cd), preferred_element_type=jnp.float32)
+        .astype(cd)
+        + layer["bo"].astype(cd)
+    )
+    x = x + attn
+
+    # --- MLP sublayer: D -> 4D -> GELU(exact) -> D -> dropout ---
+    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    h = (
+        jnp.einsum("bsd,df->bsf", h, layer["wfc"].astype(cd), preferred_element_type=jnp.float32)
+        .astype(cd)
+        + layer["bfc"].astype(cd)
+    )
+    h = jax.nn.gelu(h, approximate=False)  # torch nn.GELU default is exact erf
+    h = (
+        jnp.einsum("bsf,fd->bsd", h, layer["wproj"].astype(cd), preferred_element_type=jnp.float32)
+        .astype(cd)
+        + layer["bproj"].astype(cd)
+    )
+    h = _dropout(h, c.dropout, keys[1], deterministic)
+    return x + h
+
+
+def forward(
+    config: TinyGPTConfig,
+    params: Params,
+    idx: jax.Array,  # (B, S) int32 token ids
+    targets: Optional[jax.Array] = None,  # (B, S) int32, -1 = ignore
+    *,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Forward pass -> (logits fp32 (B,S,V), loss fp32 scalar or None).
+
+    Structure parity: reference ``TinyGPT.forward`` (train_harness.py:80-105):
+    tok_emb + pos_emb -> dropout -> blocks -> ln_f -> tied lm_head ->
+    cross-entropy(ignore_index=-1). The layer loop is a ``lax.scan`` over
+    stacked weights (single compiled block body; optional per-layer remat).
+    """
+    c = config
+    B, S = idx.shape
+    if S > c.block_size:
+        raise ValueError(f"Sequence {S} exceeds block size {c.block_size}")
+    cd = c.compute_dtype
+
+    tok = jnp.take(params["wte"], idx, axis=0)
+    pos = params["wpe"][:S]
+    x = (tok + pos[None, :, :]).astype(cd)
+
+    if dropout_key is not None and not deterministic:
+        emb_key, scan_key = jax.random.split(dropout_key)
+        x = _dropout(x, c.dropout, emb_key, deterministic)
+        layer_keys = jax.random.split(scan_key, c.n_layer)
+    else:
+        layer_keys = None
+
+    block = functools.partial(_block, c, deterministic=deterministic)
+    if c.remat:
+        block = jax.checkpoint(block)
+
+    if layer_keys is None:
+        scan_body = lambda carry, layer: (block(carry, layer, None), None)
+        xs = params["blocks"]
+    else:
+        scan_body = lambda carry, lk: (block(carry, lk[0], lk[1]), None)
+        xs = (params["blocks"], layer_keys)
+    x, _ = lax.scan(scan_body, x, xs)
+
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    # Weight-tied LM head: logits = x @ wte^T, fp32 accumulation on the MXU.
+    logits = jnp.einsum(
+        "bsd,vd->bsv", x, params["wte"].astype(cd), preferred_element_type=jnp.float32
+    )
+
+    loss = None
+    if targets is not None:
+        loss = _cross_entropy(logits, targets)
+    return logits, loss
+
+
+def _cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean CE over positions where target != -1 (parity: ignore_index=-1,
+    reference train_harness.py:98-103)."""
+    V = logits.shape[-1]
+    logits = logits.reshape(-1, V).astype(jnp.float32)
+    targets = targets.reshape(-1)
+    valid = targets != -1
+    safe = jnp.where(valid, targets, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+    nll = jnp.where(valid, logz - gold, 0.0)
+    count = jnp.maximum(valid.sum(), 1)
+    return nll.sum() / count
+
+
+def loss_fn(
+    config: TinyGPTConfig,
+    params: Params,
+    batch: jax.Array,
+    targets: jax.Array,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Scalar training loss (the differentiated function in the train step)."""
+    _, loss = forward(
+        config, params, batch, targets, dropout_key=dropout_key, deterministic=deterministic
+    )
+    return loss

@@ -1,0 +1,259 @@
+"""Flash attention — Pallas TPU forward kernel + blockwise custom VJP.
+
+The reference benchmarks vanilla O(S^2)-materialized attention
+(``nn.MultiheadAttention``, reference ``benchmarking/train_harness.py:114-116``)
+and defers "Flash Attention for 16K+ sequences" to future work
+(reference ``README.md:1026-1034``). This module supplies it TPU-natively.
+
+Forward (Pallas kernel):
+- never materializes the (S, S) score matrix in HBM — K/V stream through VMEM
+  in blocks while running-max/running-sum (online softmax) statistics fold
+  each block into the output accumulator;
+- fp32 statistics and accumulation, bf16 matmul inputs on the MXU;
+- grid (batch*heads, q_blocks, k_blocks) with the k axis innermost and
+  sequential, so the VMEM scratch accumulator persists across k blocks
+  (TPU grids execute the trailing axis as the inner sequential loop);
+- also emits the per-row logsumexp, the residual the backward pass needs;
+- ``causal=True`` masks by global position and skips fully-masked k blocks.
+
+Backward (custom VJP): recomputes attention probabilities blockwise over K
+from the saved logsumexp — the standard flash backward — as a ``lax.scan`` of
+dense jnp blocks, so peak memory is O(S * block) instead of O(S^2) and XLA
+fuses it onto the MXU on TPU. (A hand-written Pallas backward kernel is a
+further optimization, not a semantic change.)
+
+On non-TPU backends the forward kernel runs in Pallas interpret mode (slow but
+bit-honest), keeping the CPU test/smoke paths real.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _pick_block(seq_len: int, preferred: int = 512) -> int:
+    for b in (preferred, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if b <= seq_len and seq_len % b == 0:
+            return b
+    return seq_len
+
+
+def _flash_fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, bq: int, bk: int, scale: float, causal: bool,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # With causal masking, k blocks strictly above the diagonal contribute
+    # nothing — skip their compute entirely.
+    live = (not causal) or (ki * bk < (qi + 1) * bq)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)  # (bq, d)
+        k = k_ref[0].astype(jnp.float32)  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (bq, bk)
+
+        if causal:
+            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = rows >= cols
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                       # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)             # (bq, 1)
+        p = jnp.exp(s - m_new)                      # (bq, bk)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+
+        l_prev = l_scr[:, :1]
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zero output
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # lse is logically (bq,); stored sublane-broadcast as (8, bq) because
+        # TPU output blocks must tile to (8, 128).
+        lse = (m_scr[:, :1] + jnp.log(l_safe))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
+
+
+def _flash_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool, interpret: bool, bq: int, bk: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Run the Pallas kernel on (BH, S, D) inputs -> (out, lse)."""
+    BH, S, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    grid = (BH, S // bq, S // bk)
+    out, lse = pl.pallas_call(
+        functools.partial(
+            _flash_fwd_kernel, bq=bq, bk=bk, scale=scale, causal=causal
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 8, S), jnp.float32),
+        ],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, 0, qi)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-broadcast)
+            pltpu.VMEM((bq, 128), jnp.float32),  # running sum
+            pltpu.VMEM((bq, D), jnp.float32),    # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[:, 0, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(opts: Tuple, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    causal, interpret, bq, bk = opts
+    out, _ = _flash_forward(q, k, v, causal, interpret, bq, bk)
+    return out
+
+
+def _flash_fwd_rule(opts, q, k, v):
+    causal, interpret, bq, bk = opts
+    out, lse = _flash_forward(q, k, v, causal, interpret, bq, bk)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(opts, res, do):
+    """Blockwise flash backward from the saved logsumexp.
+
+    Standard identities (per batch*head row block):
+        p    = exp(q k^T * scale - lse)
+        dv   = p^T do
+        dp   = do v^T
+        ds   = p * (dp - delta) * scale,  delta = rowsum(do * o)
+        dq   = ds k ;  dk = ds^T q
+    computed as a scan over K blocks so only (S, bk) tiles materialize.
+    """
+    causal, _, _, bk = opts
+    q, k, v, out, lse = res
+    BH, S, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    f32 = jnp.float32
+    qf, kf, vf, dof = (t.astype(f32) for t in (q, k, v, do))
+    delta = jnp.sum(dof * out.astype(f32), axis=-1)  # (BH, S)
+
+    nk = S // bk
+    ks = kf.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)  # (nk, BH, bk, D)
+    vs = vf.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)
+
+    rows = jnp.arange(S)
+
+    def one_block(dq_acc, blk):
+        ki, k_b, v_b = blk
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_b, preferred_element_type=f32) * scale
+        if causal:
+            cols = ki * bk + jnp.arange(bk)
+            mask = rows[:, None] >= cols[None, :]
+            s = jnp.where(mask[None], s, NEG_INF)
+        p = jnp.exp(s - lse[:, :, None])  # (BH, S, bk)
+        if causal:
+            p = jnp.where(mask[None], p, 0.0)
+        dv_b = jnp.einsum("bqk,bqd->bkd", p, dof, preferred_element_type=f32)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, v_b, preferred_element_type=f32)
+        ds = p * (dp - delta[:, :, None]) * scale
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_b, preferred_element_type=f32)
+        dk_b = jnp.einsum("bqk,bqd->bkd", ds, qf, preferred_element_type=f32)
+        return dq_acc, (dk_b, dv_b)
+
+    dq0 = jnp.zeros((BH, S, D), f32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        one_block, dq0, (jnp.arange(nk), ks, vs)
+    )
+    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
+    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "interpret", "block_q", "block_k")
+)
+def flash_attention(
+    q: jax.Array,  # (B, S, H, D)
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    interpret: Optional[bool] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+) -> jax.Array:
+    """Multi-head flash attention over (batch, seq, heads, head_dim) inputs."""
+    B, S, H, D = q.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq = block_q or _pick_block(S)
+    bk = block_k or _pick_block(S)
+    if S % bq != 0 or S % bk != 0:
+        raise ValueError(
+            f"block sizes (block_q={bq}, block_k={bk}) must divide seq_len={S}"
+        )
+
+    # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head) pair.
+    def to_bhsd(t):
+        return t.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+    out = _flash((causal, interpret, bq, bk), to_bhsd(q), to_bhsd(k), to_bhsd(v))
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+def reference_attention(q, k, v, causal: bool = False) -> jax.Array:
+    """Materialized-softmax attention for correctness comparison (same math
+    as models.tinygpt's in-model path, without dropout)."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(q.dtype), v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)

@@ -1,0 +1,126 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Nothing like this exists in the reference (SURVEY §5.7: longest tested
+sequence is 2048, no sequence parallelism anywhere); it is a first-class
+capability here because long-context is where TPU ICI topology shines.
+
+Mechanism: with the sequence dimension sharded over the mesh axis ``seq``,
+each device keeps its local Q block resident and the K/V blocks *rotate*
+around the ring via ``ppermute`` — after N-1 hops every device has attended
+its queries to every key. Online-softmax statistics (running max / running
+sum) merge each incoming block, so the full (S, S) score matrix never exists
+anywhere and per-device attention memory is O(S_local * S_local). Communication
+rides neighbor-to-neighbor ICI links — exactly the topology ppermute maps to.
+
+Usable two ways:
+- ``ring_attention(q, k, v)`` inside a jitted function running under a mesh
+  that has a ``seq`` axis (it shard_maps itself over that axis);
+- ``ring_attention_sharded`` directly inside an existing ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_off, k_off, causal):
+    """One (local-Q x one-KV-block) pass -> (scores-exp sum stats, weighted V).
+
+    Returns (m, l, o): running-max (Sq,H,1), exp-sum (Sq,H,1), accumulator
+    (Sq,H,D) for this block alone, with global-position causal masking.
+    """
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("qhd,khd->hqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        Sq, Sk = q.shape[0], k.shape[0]
+        rows = q_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        cols = k_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where((rows >= cols)[None, :, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                          # (H, Sq)
+    p = jnp.exp(s - m[..., None])                    # (H, Sq, Sk)
+    if causal:
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1)                          # (H, Sq)
+    o = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_attention_sharded(
+    q: jax.Array,  # (B, S_local, H, D) — this device's sequence shard
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "seq",
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention body; call inside shard_map with seq sharded on axis_name."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Sl, H, D = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def one_batch(qb, kb, vb):
+        q_off = my * Sl
+        # n is a static mesh-axis size, so the ring unrolls as a Python loop:
+        # no permute is issued after the final block (the rotated K/V would be
+        # discarded), saving one neighbor exchange per call.
+        m_run = jnp.full((H, Sl), NEG_INF, jnp.float32)
+        l_run = jnp.zeros((H, Sl), jnp.float32)
+        o_run = jnp.zeros((Sl, H, D), jnp.float32)
+        k_cur, v_cur = kb, vb
+        for t in range(n):
+            # After t forward hops the resident block originated on (my - t) % n.
+            src = (my - t) % n
+            m_b, l_b, o_b = _block_attend(qb, k_cur, v_cur, q_off, src * Sl, causal)
+            # Merge online-softmax statistics (m_*: (H,Sq), o_*: (Sq,H,D)).
+            m_new = jnp.maximum(m_run, m_b)
+            a_run = jnp.exp(m_run - m_new)
+            a_b = jnp.exp(m_b - m_new)
+            l_run = l_run * a_run + l_b * a_b
+            o_run = (
+                o_run * a_run.transpose(1, 0)[:, :, None]
+                + o_b * a_b.transpose(1, 0)[:, :, None]
+            )
+            m_run = m_new
+            if t < n - 1:
+                k_cur = lax.ppermute(k_cur, axis_name, perm)
+                v_cur = lax.ppermute(v_cur, axis_name, perm)
+        l_f = jnp.where(l_run == 0.0, 1.0, l_run)
+        return (o_run / l_f.transpose(1, 0)[:, :, None]).astype(qb.dtype)
+
+    return jax.vmap(one_batch)(q, k, v)
+
+
+def ring_attention(
+    q: jax.Array,  # (B, S, H, D) — full (mesh-visible) arrays
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    axis_name: str = "seq",
+    mesh: Optional[jax.sharding.Mesh] = None,
+) -> jax.Array:
+    """Shard the sequence over ``axis_name`` and run the ring. Falls back to
+    flash attention when no such mesh axis is in scope (so models configured
+    with attention_impl='ring' still run on a plain data mesh)."""
+    if mesh is None:
+        m = jax.sharding.get_abstract_mesh()
+        mesh = m if m is not None and axis_name in m.axis_names else None
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+
+    fn = jax.shard_map(
+        functools.partial(ring_attention_sharded, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+    )
+    return fn(q, k, v)

@@ -1,0 +1,22 @@
+from .mesh import make_mesh, MeshAxes
+from .strategies import (
+    StrategyConfig,
+    STRATEGIES,
+    get_strategy,
+    load_strategy_config,
+    param_partition_specs,
+    opt_state_partition_specs,
+    batch_partition_spec,
+)
+
+__all__ = [
+    "make_mesh",
+    "MeshAxes",
+    "StrategyConfig",
+    "STRATEGIES",
+    "get_strategy",
+    "load_strategy_config",
+    "param_partition_specs",
+    "opt_state_partition_specs",
+    "batch_partition_spec",
+]

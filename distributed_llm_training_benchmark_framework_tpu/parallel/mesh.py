@@ -1,0 +1,66 @@
+"""Device mesh construction — the TPU-native replacement for process groups.
+
+The reference's communication layer is a NCCL process group built over a TCP
+rendezvous (reference ``benchmarking/train_harness.py:186-198``). On TPU the
+equivalent structure is a ``jax.sharding.Mesh`` over the chips: collectives are
+not library calls but XLA-inserted all-reduce / all-gather / reduce-scatter
+that ride the ICI torus. Axis order matters — ``mesh_utils.create_device_mesh``
+lays axes out so the innermost (fastest-varying) axis maps to physically
+adjacent chips, which is the TPU analogue of the reference's
+``NCCL_SOCKET_IFNAME``/ring-order tuning (``NETWORK_CONFIGURATION.md:243-248``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    """Canonical axis names used across the framework."""
+
+    data: str = "data"      # data parallel / ZeRO sharding axis
+    model: str = "model"    # tensor parallel axis
+    seq: str = "seq"        # sequence/context parallel axis (ring attention)
+    pipe: str = "pipe"      # pipeline stage axis
+
+
+AXES = MeshAxes()
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Tuple[str, ...] = ("data",),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh. Default: 1-D 'data' mesh over all addressable devices.
+
+    ``shape`` like (4, 2) with axis_names ('data', 'model') builds a 2-D mesh;
+    ``create_device_mesh`` chooses a device order that keeps each axis on
+    contiguous ICI links when running on real TPU topologies.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+    if int(np.prod(shape)) != len(devices):
+        devices = devices[: int(np.prod(shape))]
+    if int(np.prod(shape)) != len(devices):
+        raise ValueError(
+            f"Mesh shape {tuple(shape)} needs {int(np.prod(shape))} devices, "
+            f"have {len(devices)}"
+        )
+    if len(shape) != len(axis_names):
+        raise ValueError(f"shape {shape} vs axis_names {axis_names} rank mismatch")
+    try:
+        dev_array = mesh_utils.create_device_mesh(tuple(shape), devices=list(devices))
+    except Exception:
+        # CPU/virtual-device fallback: plain row-major reshape.
+        dev_array = np.asarray(list(devices)).reshape(tuple(shape))
+    return Mesh(dev_array, axis_names)

@@ -1,0 +1,258 @@
+"""Distributed-training strategies as *sharding specifications*.
+
+The reference implements its four strategy arms as four divergent wrapper code
+paths — torch DDP, torch FSDP, and two DeepSpeed engines (reference
+``benchmarking/train_harness.py:207-275``). On TPU/XLA the idiomatic design
+collapses all four into data: one shared jitted train step, four
+(param-sharding, grad-sharding, optimizer-state-sharding) specifications over
+a ``jax.sharding.Mesh``. XLA/GSPMD then *derives* the collective schedule the
+reference hand-picks libraries for:
+
+- **ddp**   params+opt replicated, batch sharded on 'data'  -> XLA inserts a
+  gradient all-reduce over ICI (what NCCL ring all-reduce does in DDP backward
+  hooks, reference ``train_harness.py:217-222``).
+- **fsdp**  params, grads and opt state all sharded on 'data' -> XLA inserts
+  per-use all-gather of weights and reduce-scatter of grads (the FSDP
+  schedule, reference ``train_harness.py:231-237``).
+- **zero2** params replicated, grads+opt state sharded -> grads reduce-scatter
+  into the shard, the Adam update runs on 1/N of the state, and the updates
+  all-gather back into replicated params (DeepSpeed ZeRO stage-2 semantics,
+  reference ``configs/deepspeed/zero2.json:10-25``). This is the arm XLA does
+  not give you for free — the explicit sharding constraints below ask for it.
+- **zero3** like fsdp plus per-layer rematerialization: DeepSpeed stage 3's
+  live-parameter windowing (``configs/deepspeed/zero3.json:20-26``) trades
+  memory for re-compute/re-gather; ``jax.checkpoint`` on the scanned block is
+  the XLA-native expression of the same trade.
+
+Every knob here is *live* (loaded from ``configs/strategies/*.json``) — unlike
+the reference, where ``--fsdp-config`` is accepted but never read and
+``--grad-accum`` is silently inert for DDP/FSDP (SURVEY §2.1 C8/C9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class StrategyConfig:
+    """One strategy arm = optimizer recipe + sharding layout + remat policy."""
+
+    name: str
+    # optimizer (parity: AdamW lr=1e-4 wd=0.01, reference train_harness.py:328-331
+    # and configs/deepspeed/zero2.json:27-36)
+    learning_rate: float = 1e-4
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.01
+    # DeepSpeed arms use WarmupLR(5) + grad clip 1.0 (zero2.json:2,37-44);
+    # the torch arms use neither.
+    warmup_steps: int = 0
+    grad_clip: Optional[float] = None
+    # sharding layout over the 'data' mesh axis
+    shard_params: bool = False
+    shard_grads: bool = False
+    shard_opt_state: bool = False
+    # per-layer rematerialization inside the block scan
+    remat: bool = False
+    # compute precision for matmuls ('bf16' | 'f32')
+    precision: str = "bf16"
+
+    def describe(self) -> str:
+        bits = [
+            f"params={'sharded' if self.shard_params else 'replicated'}",
+            f"grads={'reduce-scatter' if self.shard_grads else 'all-reduce'}",
+            f"opt_state={'sharded' if self.shard_opt_state else 'replicated'}",
+        ]
+        if self.remat:
+            bits.append("remat=per-layer")
+        return f"{self.name}: " + ", ".join(bits)
+
+
+STRATEGIES: Dict[str, StrategyConfig] = {
+    "ddp": StrategyConfig(name="ddp"),
+    "fsdp": StrategyConfig(
+        name="fsdp", shard_params=True, shard_grads=True, shard_opt_state=True
+    ),
+    "zero2": StrategyConfig(
+        name="zero2",
+        shard_grads=True,
+        shard_opt_state=True,
+        warmup_steps=5,
+        grad_clip=1.0,
+    ),
+    "zero3": StrategyConfig(
+        name="zero3",
+        shard_params=True,
+        shard_grads=True,
+        shard_opt_state=True,
+        warmup_steps=5,
+        grad_clip=1.0,
+        remat=True,
+    ),
+}
+
+
+def get_strategy(name: str) -> StrategyConfig:
+    if name not in STRATEGIES:
+        raise ValueError(f"Unknown strategy {name!r} (expected one of {sorted(STRATEGIES)})")
+    return STRATEGIES[name]
+
+
+def load_strategy_config(path: str) -> StrategyConfig:
+    """Load a strategy arm from a JSON config file (configs/strategies/*.json).
+
+    File format (every field live — this replaces both the reference's
+    DeepSpeed JSONs, which were loaded and mutated at runtime
+    (train_harness.py:246-262), and its FSDP YAML, which was dead config):
+
+        {"strategy": "zero2",
+         "optimizer": {"lr": 1e-4, "betas": [0.9, 0.999], "eps": 1e-8,
+                        "weight_decay": 0.01},
+         "scheduler": {"warmup_steps": 5},
+         "grad_clip": 1.0,
+         "precision": "bf16",
+         "sharding": {"params": false, "grads": true, "opt_state": true},
+         "remat": false}
+    """
+    with open(path) as f:
+        raw = json.load(f)
+    name = raw.get("strategy")
+    base = get_strategy(name) if name in STRATEGIES else StrategyConfig(name=name or os.path.basename(path))
+    opt = raw.get("optimizer", {})
+    sched = raw.get("scheduler", {})
+    shard = raw.get("sharding", {})
+    return dataclasses.replace(
+        base,
+        learning_rate=float(opt.get("lr", base.learning_rate)),
+        betas=tuple(opt.get("betas", base.betas)),
+        eps=float(opt.get("eps", base.eps)),
+        weight_decay=float(opt.get("weight_decay", base.weight_decay)),
+        warmup_steps=int(sched.get("warmup_steps", base.warmup_steps)),
+        grad_clip=raw.get("grad_clip", base.grad_clip),
+        precision=raw.get("precision", base.precision),
+        shard_params=bool(shard.get("params", base.shard_params)),
+        shard_grads=bool(shard.get("grads", base.shard_grads)),
+        shard_opt_state=bool(shard.get("opt_state", base.shard_opt_state)),
+        remat=bool(raw.get("remat", base.remat)),
+    )
+
+
+def make_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
+    """AdamW (+ optional global-norm clip + optional linear warmup).
+
+    Mirrors the reference recipes: bare AdamW(1e-4, wd=0.01) for ddp/fsdp
+    (train_harness.py:328-331); AdamW + WarmupLR(5) + clip 1.0 for the ZeRO
+    arms (configs/deepspeed/zero2.json:2,27-44).
+    """
+    if strategy.warmup_steps > 0:
+        lr = optax.linear_schedule(
+            init_value=0.0,
+            end_value=strategy.learning_rate,
+            transition_steps=strategy.warmup_steps,
+        )
+    else:
+        lr = strategy.learning_rate
+    tx = optax.adamw(
+        learning_rate=lr,
+        b1=strategy.betas[0],
+        b2=strategy.betas[1],
+        eps=strategy.eps,
+        weight_decay=strategy.weight_decay,
+    )
+    if strategy.grad_clip is not None:
+        tx = optax.chain(optax.clip_by_global_norm(float(strategy.grad_clip)), tx)
+    return tx
+
+
+# ---------------------------------------------------------------------------
+# PartitionSpec derivation
+# ---------------------------------------------------------------------------
+
+
+def _shard_leaf_spec(shape: Tuple[int, ...], n_shards: int, is_block_leaf: bool) -> P:
+    """FSDP-style per-leaf spec: shard the largest divisible axis on 'data'.
+
+    For stacked block leaves (leading 'layers' scan axis) we prefer a tensor
+    axis over the layers axis: sharding inside the layer keeps the scan body's
+    dynamic-slice local and lets XLA all-gather exactly one layer's shard per
+    scan iteration (the FSDP/ZeRO-3 schedule). The layers axis is the fallback.
+    """
+    spec = [None] * len(shape)
+    axes = list(range(len(shape)))
+    candidates = axes[1:] + axes[:1] if is_block_leaf and len(shape) > 1 else axes
+    # Prefer the largest divisible axis among the candidates.
+    best = None
+    for ax in candidates:
+        if shape[ax] % n_shards == 0 and shape[ax] >= n_shards:
+            if best is None or shape[ax] > shape[best]:
+                best = ax
+    if best is not None:
+        spec[best] = "data"
+    return P(*spec)
+
+
+def param_partition_specs(params: Params, mesh: Mesh, shard: bool) -> Params:
+    """PartitionSpec pytree for the params under a given strategy."""
+    n = mesh.shape.get("data", 1)
+    if not shard or n == 1:
+        return jax.tree.map(lambda _: P(), params)
+
+    def spec(path, leaf):
+        is_block = any(getattr(p, "key", None) == "blocks" for p in path)
+        return _shard_leaf_spec(leaf.shape, n, is_block)
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def opt_state_partition_specs(
+    optimizer: optax.GradientTransformation,
+    params: Params,
+    param_specs: Params,
+    mesh: Mesh,
+    shard: bool,
+) -> Any:
+    """PartitionSpec pytree for the optimizer state.
+
+    Param-shaped leaves (Adam mu/nu, weight-decay masks, ...) inherit either
+    the param's own spec (fsdp/zero3) or an FSDP-style sharded spec of their
+    own (zero2: replicated params but *sharded* moments — the defining ZeRO-2
+    layout). Non-param leaves (step counts) are replicated.
+    """
+    state_shapes = jax.eval_shape(optimizer.init, params)
+    if shard:
+        moment_specs = param_partition_specs(params, mesh, shard=True)
+    else:
+        moment_specs = param_specs
+    return optax.tree_map_params(
+        optimizer,
+        lambda _, spec: spec,
+        state_shapes,
+        moment_specs,
+        transform_non_params=lambda _: P(),
+    )
+
+
+def batch_partition_spec(mesh: Mesh) -> P:
+    """Global batch is sharded along its leading (batch) dim on 'data'."""
+    if mesh.shape.get("data", 1) > 1:
+        return P("data")
+    return P()
+
+
+def named(mesh: Mesh, spec_tree: Any) -> Any:
+    """PartitionSpec pytree -> NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )

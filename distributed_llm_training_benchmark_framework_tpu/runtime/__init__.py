@@ -1,0 +1,3 @@
+from . import distributed
+
+__all__ = ["distributed"]

@@ -1,0 +1,97 @@
+"""Multi-host rendezvous — the TPU replacement for NCCL process groups.
+
+The reference rendezvous is ``dist.init_process_group(backend='nccl',
+init_method='tcp://MASTER_ADDR:MASTER_PORT', world_size, rank)`` (reference
+``benchmarking/train_harness.py:186-198``), one process per GPU. On TPU the
+unit is one *process per host*, each owning several chips, and the rendezvous
+is ``jax.distributed.initialize(coordinator_address, num_processes,
+process_id)`` — the coordinator plays the MASTER_ADDR role and the
+coordination service then carries heartbeats/failure detection (SURVEY §5.2).
+
+Env contract (mirrors reference ``docker/entrypoint.sh:7-36``; TPU-specific
+variables win when present):
+
+    COORDINATOR_ADDRESS  <-> MASTER_ADDR:MASTER_PORT
+    NUM_PROCESSES        <-> number of hosts (NOT chips)
+    PROCESS_ID           <-> RANK; derived from TPU_WORKER_ID or
+                             JOB_COMPLETION_INDEX on K8s Indexed Jobs
+
+``world_size`` throughout this framework counts *chips* (= the reference's
+GPU count), never processes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def setup_distributed(
+    master_addr: Optional[str] = None,
+    master_port: int = 29500,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize multi-host JAX if (and only if) this is a multi-process run.
+
+    Single-process runs (the common single-host pod-slice case, and the smoke
+    path) skip initialization entirely — parity with the reference's
+    ``world_size==1`` skip (``train_harness.py:197-198``).
+
+    Returns True if jax.distributed was initialized by this call.
+    """
+    n = num_processes if num_processes is not None else int(
+        os.environ.get("NUM_PROCESSES", "1")
+    )
+    if n <= 1:
+        return False
+
+    pid = process_id
+    if pid is None:
+        for var in ("PROCESS_ID", "TPU_WORKER_ID", "RANK"):
+            if os.environ.get(var):
+                pid = int(os.environ[var])
+                break
+        else:
+            # K8s Indexed Job: completion index 0..n-1 is the process id.
+            idx = os.environ.get("JOB_COMPLETION_INDEX")
+            pid = int(idx) if idx is not None else 0
+
+    coord = os.environ.get("COORDINATOR_ADDRESS")
+    if coord is None:
+        addr = master_addr or os.environ.get("MASTER_ADDR", "127.0.0.1")
+        coord = f"{addr}:{master_port}"
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=n, process_id=pid
+    )
+    return True
+
+
+def cleanup_distributed() -> None:
+    """Tear down the coordination service (parity: reference
+    ``cleanup_distributed``, train_harness.py:201-204)."""
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "benchmark_end") -> None:
+    """Cross-host barrier before final metrics (parity: dist.barrier(),
+    reference train_harness.py:396-397). A tiny psum over all devices is the
+    XLA-native barrier; single-process it is a no-op."""
+    if jax.process_count() == 1:
+        return
+    import jax.numpy as jnp
+
+    x = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+        jnp.ones((jax.local_device_count(),))
+    )
+    jax.block_until_ready(x)

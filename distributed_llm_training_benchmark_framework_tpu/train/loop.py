@@ -1,0 +1,140 @@
+"""The timed benchmark loop.
+
+Hot-loop parity with the reference (``benchmarking/train_harness.py:278-458``)
+with TPU-honest timing:
+
+- per-step wall-clock via ``time.perf_counter`` around the whole step;
+- JAX dispatch is asynchronous, so each timed step ends with
+  ``jax.block_until_ready(loss)`` — the explicit equivalent of the device
+  sync the reference gets implicitly from ``loss.item()`` (``:390``);
+- warmup steps excluded from the averages (``:388-390``);
+- rank-0 progress print every 10 steps (``:392-393``);
+- cross-host barrier before final metrics (``:396-397``).
+
+One loop serves every strategy arm — the arm only changes the shardings baked
+into ``state.step_fn``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..data import SyntheticDataset
+from ..models import get_model_config
+from ..parallel import make_mesh, StrategyConfig
+from ..runtime import distributed as dist
+from ..utils import metrics as metrics_mod
+from .step import create_train_state
+
+
+def run_benchmark(
+    *,
+    strategy: StrategyConfig,
+    tier: str,
+    seq_len: int,
+    steps: int,
+    warmup_steps: int,
+    per_device_batch: int,
+    grad_accum: int,
+    world_size: int,
+    rank: int = 0,
+    results_dir: Optional[str] = None,
+    seed: int = 42,
+    attention_impl: str = "reference",
+    dropout: Optional[float] = None,
+    dataset_size: int = 1000,
+    log_every: int = 10,
+    profile_dir: Optional[str] = None,
+) -> metrics_mod.BenchmarkResult:
+    """Run one benchmark arm end-to-end and (on rank 0) emit its result."""
+    is_main = dist.is_main_process() and rank == 0
+    devices = jax.devices()
+    if world_size > len(devices):
+        raise ValueError(
+            f"world_size={world_size} but only {len(devices)} devices visible"
+        )
+    mesh = make_mesh((world_size,), ("data",), devices=devices[:world_size])
+
+    overrides = {} if dropout is None else {"dropout": dropout}
+    model_config = get_model_config(
+        tier, seq_len, attention_impl=attention_impl, **overrides
+    )
+    if is_main:
+        print(f"Strategy: {strategy.describe()}")
+        if attention_impl != "reference" and model_config.dropout > 0:
+            print(
+                f"Note: attention_impl={attention_impl!r} does not apply "
+                "attention-probability dropout (embedding/MLP dropout still "
+                "active); use --dropout 0 for exact cross-impl loss parity"
+            )
+        print(
+            f"Mesh: {dict(mesh.shape)} over {devices[0].device_kind!r} devices"
+        )
+
+    t_init = time.perf_counter()
+    state = create_train_state(
+        model_config, strategy, mesh, seed=seed, grad_accum=grad_accum
+    )
+    if is_main:
+        print(f"Model initialized: {state.n_params/1e6:.2f}M parameters")
+        print(f"Init time: {time.perf_counter() - t_init:.1f}s")
+
+    ds = SyntheticDataset(
+        vocab_size=model_config.vocab_size, seq_len=seq_len, size=dataset_size, seed=seed
+    )
+    if is_main:
+        print(f"SyntheticDataset: {dataset_size} samples, seq_len={seq_len}")
+
+    global_micro = per_device_batch * world_size
+    params, opt_state = state.params, state.opt_state
+    step_times, losses = [], []
+    trace_started = False
+
+    for step in range(steps):
+        if profile_dir and step == warmup_steps and is_main and not trace_started:
+            jax.profiler.start_trace(profile_dir)
+            trace_started = True
+        batch = ds.batch_for_step(step, global_micro * grad_accum)
+        batch = batch.reshape(grad_accum, global_micro, seq_len)
+        batch = jax.device_put(batch, state.batch_sharding)
+
+        t0 = time.perf_counter()
+        params, opt_state, loss = state.step_fn(params, opt_state, batch, step)
+        loss = jax.block_until_ready(loss)  # honest wall-clock under async dispatch
+        t1 = time.perf_counter()
+
+        step_time = t1 - t0
+        if step >= warmup_steps:
+            step_times.append(step_time)
+            losses.append(float(loss))
+        if is_main and step % log_every == 0:
+            print(f"[Step {step:04d}] Loss: {float(loss):.4f}, Time: {step_time:.3f}s")
+
+    if trace_started:
+        jax.profiler.stop_trace()
+
+    dist.barrier()
+
+    result = metrics_mod.compute_result(
+        strategy=strategy.name,
+        world_size=world_size,
+        rank=rank,
+        seq_len=seq_len,
+        tier=tier,
+        steps=steps,
+        per_device_batch=per_device_batch,
+        grad_accum=grad_accum,
+        step_times=step_times,
+        losses=losses,
+        device_kind=devices[0].device_kind,
+        backend=jax.default_backend(),
+        n_params=state.n_params,
+        attention_impl=attention_impl,
+    )
+    if results_dir is not None:
+        metrics_mod.emit_result(result, results_dir, is_main=is_main)
+    return result

@@ -1,0 +1,210 @@
+"""The unified train step — one compiled function serves all strategy arms.
+
+Where the reference maintains two divergent hot loops (a DeepSpeed engine path
+and an AMP/GradScaler path, reference ``benchmarking/train_harness.py:364-382``),
+here there is exactly one train step:
+
+    value_and_grad(loss) -> [sharding constraint] -> optax update -> apply
+
+jitted with per-strategy ``in_shardings``/``out_shardings``. The strategy's
+PartitionSpecs (see ``parallel.strategies``) tell XLA where the collectives
+go; donation of params + optimizer state makes the update in-place in HBM.
+
+Gradient accumulation is *real* (a ``lax.scan`` over microbatches with fp32
+accumulators) — the reference accepts ``--grad-accum`` but silently ignores it
+for DDP/FSDP (reference ``train_harness.py:369-382``, SURVEY §2.1 C8).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import tinygpt
+from ..parallel import strategies as strat
+
+Params = Any
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Everything the benchmark loop needs, pre-placed on the mesh."""
+
+    params: Params
+    opt_state: Any
+    step_fn: Callable  # (params, opt_state, batch, step) -> (params, opt_state, loss)
+    mesh: Mesh
+    param_specs: Params
+    opt_specs: Any
+    batch_sharding: NamedSharding
+    model_config: tinygpt.TinyGPTConfig
+    strategy: strat.StrategyConfig
+    n_params: int
+
+
+def _resolve_model_config(
+    model_config: tinygpt.TinyGPTConfig, strategy: strat.StrategyConfig
+) -> tinygpt.TinyGPTConfig:
+    """Fold strategy-level knobs (remat, precision) into the model config."""
+    compute_dtype = jnp.bfloat16 if strategy.precision == "bf16" else jnp.float32
+    return dataclasses.replace(
+        model_config, remat=strategy.remat, compute_dtype=compute_dtype
+    )
+
+
+def make_train_step(
+    model_config: tinygpt.TinyGPTConfig,
+    strategy: strat.StrategyConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    param_specs: Params,
+    opt_specs: Any,
+    grad_accum: int = 1,
+    seed: int = 0,
+    deterministic_dropout: bool = False,
+) -> Callable:
+    """Build the jitted train step for one strategy arm.
+
+    batch layout: (grad_accum, global_microbatch, seq_len) int32; targets are
+    the inputs themselves (parity: reference ``train_harness.py:359``).
+    """
+    cfg = _resolve_model_config(model_config, strategy)
+    grad_sharded_specs = strat.param_partition_specs(
+        jax.eval_shape(functools.partial(tinygpt.init_params, cfg), jax.random.key(0)),
+        mesh,
+        shard=True,
+    )
+    batch_spec = strat.batch_partition_spec(mesh)
+    # (accum, batch, seq): shard the *batch* dim, accum dim is sequential.
+    full_batch_spec = P(None, *batch_spec)
+
+    def micro_loss(params: Params, micro: jax.Array, key: jax.Array) -> jax.Array:
+        return tinygpt.loss_fn(
+            cfg,
+            params,
+            micro,
+            micro,  # targets = inputs, unshifted (reference parity)
+            dropout_key=key,
+            deterministic=deterministic_dropout,
+        )
+
+    def train_step(params, opt_state, batch, step):
+        base_key = jax.random.fold_in(jax.random.key(seed), step)
+
+        def one_micro(carry, inp):
+            loss_acc, grad_acc = carry
+            micro, key = inp
+            loss, grads = jax.value_and_grad(micro_loss)(params, micro, key)
+            grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+            return (loss_acc + loss, grad_acc), None
+
+        if grad_accum == 1:
+            key = jax.random.fold_in(base_key, 0)
+            loss, grads = jax.value_and_grad(micro_loss)(params, batch[0], key)
+        else:
+            keys = jax.random.split(base_key, grad_accum)
+            zero_grads = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+            (loss_sum, grads), _ = lax.scan(
+                one_micro, (jnp.zeros((), jnp.float32), zero_grads), (batch, keys)
+            )
+            loss = loss_sum / grad_accum
+            grads = jax.tree.map(lambda g: g / grad_accum, grads)
+
+        if strategy.shard_grads and not strategy.shard_params:
+            # ZeRO-2: reduce-scatter the gradients into the optimizer shard.
+            grads = lax.with_sharding_constraint(grads, strat.named(mesh, grad_sharded_specs))
+
+        updates, new_opt_state = optimizer.update(grads, opt_state, params)
+
+        if strategy.shard_grads and not strategy.shard_params:
+            # ZeRO-2: all-gather the (sharded) updates back onto replicated params.
+            updates = lax.with_sharding_constraint(updates, strat.named(mesh, param_specs))
+
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, loss
+
+    return jax.jit(
+        train_step,
+        in_shardings=(
+            strat.named(mesh, param_specs),
+            strat.named(mesh, opt_specs),
+            NamedSharding(mesh, full_batch_spec),
+            None,
+        ),
+        out_shardings=(
+            strat.named(mesh, param_specs),
+            strat.named(mesh, opt_specs),
+            NamedSharding(mesh, P()),
+        ),
+        donate_argnums=(0, 1),
+    )
+
+
+def create_train_state(
+    model_config: tinygpt.TinyGPTConfig,
+    strategy: strat.StrategyConfig,
+    mesh: Mesh,
+    seed: int = 42,
+    grad_accum: int = 1,
+    deterministic_dropout: bool = False,
+) -> TrainState:
+    """Initialize params + optimizer state directly into their target shardings.
+
+    Init is jitted with ``out_shardings`` so tier-B params materialize sharded
+    across HBM — no single host/device ever holds the full replicated tree
+    (the TPU analogue of FSDP's deferred/sharded init).
+    """
+    cfg = _resolve_model_config(model_config, strategy)
+    optimizer = strat.make_optimizer(strategy)
+
+    params_shape = jax.eval_shape(
+        functools.partial(tinygpt.init_params, cfg), jax.random.key(0)
+    )
+    param_specs = strat.param_partition_specs(
+        params_shape, mesh, shard=strategy.shard_params
+    )
+    opt_specs = strat.opt_state_partition_specs(
+        optimizer, params_shape, param_specs, mesh, shard=strategy.shard_opt_state
+    )
+
+    with mesh:
+        params = jax.jit(
+            functools.partial(tinygpt.init_params, cfg),
+            out_shardings=strat.named(mesh, param_specs),
+        )(jax.random.key(seed))
+        opt_state = jax.jit(
+            optimizer.init, out_shardings=strat.named(mesh, opt_specs)
+        )(params)
+
+    step_fn = make_train_step(
+        model_config,
+        strategy,
+        optimizer,
+        mesh,
+        param_specs,
+        opt_specs,
+        grad_accum=grad_accum,
+        seed=seed,
+        deterministic_dropout=deterministic_dropout,
+    )
+    return TrainState(
+        params=params,
+        opt_state=opt_state,
+        step_fn=step_fn,
+        mesh=mesh,
+        param_specs=param_specs,
+        opt_specs=opt_specs,
+        batch_sharding=NamedSharding(mesh, P(None, *strat.batch_partition_spec(mesh))),
+        model_config=cfg,
+        strategy=strategy,
+        n_params=tinygpt.count_params(params),
+    )

@@ -1,0 +1,170 @@
+"""Benchmark metrics + the result.json / stdout-marker export protocol.
+
+This is the reference's core subsystem (SURVEY §5.5), reproduced
+contract-for-contract so downstream tooling (collect scripts, parsers,
+plotters) works unchanged against TPU pod logs:
+
+- result schema: identical keys to reference ``train_harness.py:415-429`` /
+  ``results/example_output/README.md:26-41`` (``peak_vram_gb`` keeps its name
+  for schema compatibility — on TPU it reports peak HBM bytes in use), plus
+  additive TPU fields (``peak_hbm_gb``, ``device_kind``, ``backend``,
+  ``n_params``) that no reference consumer needs to read;
+- file name: ``result_{strategy}_ws{N}_seq{L}_tier{T}.json``
+  (reference ``train_harness.py:443-446``);
+- stdout markers: ``BENCHMARK_RESULT_JSON_START`` / ``_END`` delimit the JSON
+  on stdout (reference ``train_harness.py:452-456``) — the load-bearing export
+  channel, because pod filesystems are ephemeral and results get scraped from
+  ``kubectl logs`` (reference ``scripts/collect_results.sh:50-52``).
+
+Metric formulas (parity, reference ``train_harness.py:399-413``):
+- ``tokens_per_sec = tokens_per_step / mean_step_time`` — with the one honest
+  correction that ``tokens_per_step`` includes ``grad_accum``, because our
+  accumulation is real (the reference's is inert for DDP/FSDP yet it still
+  reports per-microbatch tokens);
+- ``h2d_gbps_per_gpu = batch*seq*4 bytes / step_time / 1e9`` — the reference's
+  admitted FP32-equivalent transfer proxy, kept for comparability;
+- warmup steps are excluded from timing (reference ``train_harness.py:388-390``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+MARKER_START = "BENCHMARK_RESULT_JSON_START"
+MARKER_END = "BENCHMARK_RESULT_JSON_END"
+
+
+def peak_hbm_bytes() -> Optional[int]:
+    """Peak device-memory bytes in use, or None when the backend can't say.
+
+    TPU runtimes expose ``memory_stats()['peak_bytes_in_use']`` per device
+    (the HBM analogue of ``torch.cuda.max_memory_allocated``, reference
+    ``train_harness.py:406-408``); CPU backends typically return None.
+    """
+    import jax
+
+    peaks = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "peak_bytes_in_use" in stats:
+            peaks.append(int(stats["peak_bytes_in_use"]))
+    return max(peaks) if peaks else None
+
+
+@dataclasses.dataclass
+class BenchmarkResult:
+    strategy: str
+    world_size: int
+    rank: int
+    seq_len: int
+    tier: str
+    steps: int
+    per_device_batch: int
+    grad_accum: int
+    tokens_per_sec: float
+    mean_step_time_sec: float
+    mean_loss: float
+    peak_vram_gb: float  # schema-compat name; peak HBM GB on TPU
+    h2d_gbps_per_gpu: float
+    # --- additive TPU-native fields (ignored by reference-era consumers) ---
+    peak_hbm_gb: float = 0.0
+    device_kind: str = ""
+    backend: str = ""
+    n_params: int = 0
+    attention_impl: str = "reference"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def result_filename(self) -> str:
+        return (
+            f"result_{self.strategy}_ws{self.world_size}"
+            f"_seq{self.seq_len}_tier{self.tier}.json"
+        )
+
+
+def compute_result(
+    *,
+    strategy: str,
+    world_size: int,
+    rank: int,
+    seq_len: int,
+    tier: str,
+    steps: int,
+    per_device_batch: int,
+    grad_accum: int,
+    step_times: List[float],
+    losses: List[float],
+    device_kind: str = "",
+    backend: str = "",
+    n_params: int = 0,
+    attention_impl: str = "reference",
+) -> BenchmarkResult:
+    mean_step = sum(step_times) / len(step_times) if step_times else 0.0
+    mean_loss = sum(losses) / len(losses) if losses else 0.0
+    # Honest accounting: a step consumes per_device_batch * grad_accum
+    # sequences per device (our accumulation is real; see module docstring).
+    tokens_per_step = per_device_batch * grad_accum * seq_len * world_size
+    tps = tokens_per_step / mean_step if mean_step > 0 else 0.0
+    bytes_per_step = per_device_batch * grad_accum * seq_len * 4
+    h2d = (bytes_per_step / mean_step) / 1e9 if mean_step > 0 else 0.0
+    peak = peak_hbm_bytes()
+    peak_gb = (peak or 0) / 1e9
+    return BenchmarkResult(
+        strategy=strategy,
+        world_size=world_size,
+        rank=rank,
+        seq_len=seq_len,
+        tier=tier,
+        steps=steps,
+        per_device_batch=per_device_batch,
+        grad_accum=grad_accum,
+        tokens_per_sec=tps,
+        mean_step_time_sec=mean_step,
+        mean_loss=mean_loss,
+        peak_vram_gb=peak_gb,
+        h2d_gbps_per_gpu=h2d,
+        peak_hbm_gb=peak_gb,
+        device_kind=device_kind,
+        backend=backend,
+        n_params=n_params,
+        attention_impl=attention_impl,
+    )
+
+
+def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True) -> Optional[str]:
+    """Write result.json + print the marker-delimited JSON block (rank 0 only).
+
+    Console format parity: reference ``train_harness.py:431-456``.
+    """
+    if not is_main:
+        return None
+    payload = json.dumps(result.to_dict(), indent=2)
+
+    print("\n" + "=" * 80)
+    print("Benchmark Results:")
+    print(f"  Tokens/sec:       {result.tokens_per_sec:,.0f}")
+    print(f"  Mean step time:   {result.mean_step_time_sec:.4f}s")
+    print(f"  Peak HBM/chip:    {result.peak_hbm_gb:.2f} GB")
+    print(f"  H2D GB/s/chip:    {result.h2d_gbps_per_gpu:.3f}")
+    print(f"  Mean loss:        {result.mean_loss:.4f}")
+    print("=" * 80 + "\n")
+
+    os.makedirs(results_dir, exist_ok=True)
+    path = os.path.join(results_dir, result.result_filename())
+    with open(path, "w") as f:
+        f.write(payload)
+    print(f"Results saved to: {path}")
+
+    print("\n" + "=" * 80)
+    print(MARKER_START)
+    print(payload)
+    print(MARKER_END)
+    print("=" * 80 + "\n")
+    return path

@@ -1,0 +1,37 @@
+"""Platform selection guard.
+
+Some TPU environments register their platform plugin from ``sitecustomize`` at
+interpreter startup and force ``jax_platforms`` via ``jax.config.update``,
+which silently overrides a user's ``JAX_PLATFORMS`` environment variable. The
+CPU-smoke and virtual-mesh test paths (SURVEY §4) depend on that variable
+working, so every CLI entry point calls :func:`honor_jax_platforms_env` first.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    """Make JAX_PLATFORMS from the environment win over config forced earlier."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        cur = jax.config.jax_platforms or ""
+    except AttributeError:
+        cur = ""
+    if cur.split(",")[0] == want.split(",")[0]:
+        return
+    jax.config.update("jax_platforms", want)
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if _xb.backends_are_initialized():
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+    except Exception:
+        pass

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Container entrypoint: env-var contract -> harness CLI.
+#
+# Contract parity with the reference entrypoint (docker/entrypoint.sh there:
+# env defaults, RANK from JOB_COMPLETION_INDEX, MASTER_ADDR resolution, device
+# probe, exec python -u). TPU differences:
+#   - all workers are symmetric (no master/worker split): the process id comes
+#     from TPU_WORKER_ID (pod slices) or JOB_COMPLETION_INDEX (Indexed Jobs);
+#   - NUM_PROCESSES counts hosts; WORLD_SIZE counts chips;
+#   - the device probe is a JAX device listing instead of nvidia-smi.
+set -euo pipefail
+
+echo "=== TPU Distributed Training Entrypoint ==="
+date
+
+export STRATEGY="${STRATEGY:-ddp}"            # ddp | fsdp | zero2 | zero3
+export WORLD_SIZE="${WORLD_SIZE:-1}"          # total chips
+export NUM_PROCESSES="${NUM_PROCESSES:-1}"    # host processes
+
+# Process id: TPU pod-slice env wins, then K8s Indexed Job completion index.
+if [ -n "${TPU_WORKER_ID:-}" ]; then
+  export RANK="$TPU_WORKER_ID"
+elif [ -n "${JOB_COMPLETION_INDEX:-}" ]; then
+  export RANK="$JOB_COMPLETION_INDEX"
+else
+  export RANK="${RANK:-0}"
+fi
+
+# Coordinator: rank 0 announces its own POD_IP; everyone else uses the
+# headless-service DNS name (same hostNetwork/DNS pattern the reference
+# documents for its NCCL rendezvous).
+if [ "$RANK" = "0" ] && [ -n "${POD_IP:-}" ]; then
+  export MASTER_ADDR="$POD_IP"
+else
+  export MASTER_ADDR="${MASTER_ADDR:-127.0.0.1}"
+fi
+export MASTER_PORT="${MASTER_PORT:-29500}"
+
+export SEQ_LEN="${SEQ_LEN:-2048}"
+export TIER="${TIER:-A}"                      # A | B | S
+export STEPS="${STEPS:-50}"
+export WARMUP_STEPS="${WARMUP_STEPS:-5}"
+export PER_DEVICE_BATCH="${PER_DEVICE_BATCH:-1}"
+export GRAD_ACCUM="${GRAD_ACCUM:-1}"
+export ATTENTION="${ATTENTION:-reference}"
+export SYNTHETIC="${SYNTHETIC:-true}"
+export RESULTS_DIR="${RESULTS_DIR:-/results}"
+
+echo "Config:"
+for v in STRATEGY WORLD_SIZE NUM_PROCESSES RANK MASTER_ADDR MASTER_PORT \
+         SEQ_LEN TIER STEPS WARMUP_STEPS PER_DEVICE_BATCH GRAD_ACCUM ATTENTION; do
+  echo "  $v=${!v}"
+done
+echo ""
+
+echo "TPU Status:"
+python - <<'EOF' || echo "WARNING: device probe failed"
+import jax
+print(f"  backend={jax.default_backend()} devices={jax.devices()}")
+EOF
+echo ""
+
+ARGS="--strategy ${STRATEGY} --world-size ${WORLD_SIZE} --rank ${RANK}"
+ARGS="${ARGS} --num-processes ${NUM_PROCESSES}"
+ARGS="${ARGS} --master-addr ${MASTER_ADDR} --master-port ${MASTER_PORT}"
+ARGS="${ARGS} --seq-len ${SEQ_LEN} --tier ${TIER} --steps ${STEPS}"
+ARGS="${ARGS} --warmup-steps ${WARMUP_STEPS}"
+ARGS="${ARGS} --per-device-batch ${PER_DEVICE_BATCH} --grad-accum ${GRAD_ACCUM}"
+ARGS="${ARGS} --attention ${ATTENTION} --results-dir ${RESULTS_DIR}"
+if [[ "${SYNTHETIC}" == "true" ]]; then ARGS="${ARGS} --synthetic"; fi
+if [[ "${STRATEGY}" == "zero2" || "${STRATEGY}" == "zero3" ]]; then
+  ARGS="${ARGS} --strategy-config /app/configs/strategies/${STRATEGY}.json"
+fi
+
+echo "=== Launching Training ==="
+echo "Command: python -u /app/benchmarking/train_harness.py ${ARGS}"
+echo ""
+exec python -u /app/benchmarking/train_harness.py ${ARGS}

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Build the benchmark image (parity: reference scripts/build.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+IMAGE="${IMAGE:-tpu-llm-bench:latest}"
+docker build -f docker/Dockerfile -t "$IMAGE" .
+echo "Built $IMAGE"

@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# Cluster preflight: verify TPU capacity before launching the suite.
+#
+# Parity with reference scripts/check_cluster_gpus.sh: kubectl connectivity,
+# device-plugin presence, per-node capacity table, total/in-use accounting,
+# namespace existence, recommended test matrix. GPU checks become TPU checks
+# (google.com/tpu resource, TPU node selectors/topology labels).
+set -uo pipefail
+
+echo "=== TPU Cluster Preflight ==="
+
+echo "--- kubectl connectivity ---"
+if ! kubectl version >/dev/null 2>&1; then
+  echo "FAIL: kubectl cannot reach a cluster"; exit 1
+fi
+echo "OK"
+
+echo "--- TPU-capable nodes ---"
+NODES=$(kubectl get nodes -o json)
+echo "$NODES" | jq -r '
+  .items[]
+  | select(.status.capacity["google.com/tpu"] != null)
+  | [.metadata.name,
+     (.metadata.labels["cloud.google.com/gke-tpu-accelerator"] // "?"),
+     (.metadata.labels["cloud.google.com/gke-tpu-topology"] // "?"),
+     .status.capacity["google.com/tpu"],
+     .status.allocatable["google.com/tpu"]]
+  | @tsv' | column -t -N "NODE,ACCELERATOR,TOPOLOGY,CAPACITY,ALLOCATABLE" \
+  || echo "(no TPU nodes found)"
+
+TOTAL=$(echo "$NODES" | jq '[.items[].status.allocatable["google.com/tpu"] // "0" | tonumber] | add')
+echo "Total allocatable TPU chips: ${TOTAL:-0}"
+
+echo "--- chips currently requested by pods ---"
+IN_USE=$(kubectl get pods --all-namespaces -o json | jq '
+  [.items[].spec.containers[].resources.requests["google.com/tpu"] // "0" | tonumber] | add')
+echo "In use: ${IN_USE:-0} / ${TOTAL:-0}"
+
+echo "--- bench namespace ---"
+if kubectl get namespace bench >/dev/null 2>&1; then
+  echo "OK: namespace 'bench' exists"
+else
+  echo "NOTE: namespace 'bench' missing — will be created by launch scripts"
+fi
+
+if [ "${TOTAL:-0}" -ge 4 ]; then
+  echo ""
+  echo "Recommended matrix (>=4 chips available):"
+  echo "  strategies: ddp fsdp zero2 zero3"
+  echo "  world sizes: 1 2 4$( [ "$TOTAL" -ge 8 ] && echo ' 8')"
+  echo "  scripts/run_all_benchmarks.sh --k8s"
+fi

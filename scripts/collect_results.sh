@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Collect benchmark results by scraping the stdout marker protocol.
+#
+# Contract parity with the reference collector (scripts/collect_results.sh
+# there): results are extracted from logs between BENCHMARK_RESULT_JSON_START
+# and BENCHMARK_RESULT_JSON_END markers, because pod/emptyDir filesystems die
+# with the pod. Two modes:
+#
+#   collect_results.sh --log <file> <outdir>        # local-run log file
+#   collect_results.sh --k8s <namespace> <job> <outdir>   # kubectl logs
+set -euo pipefail
+
+usage() { echo "usage: $0 --log <file> <outdir> | --k8s <ns> <job> <outdir>"; exit 1; }
+
+extract() {
+  local log="$1" out="$2"
+  mkdir -p "$out"
+  # sed range between markers, then drop the marker lines themselves.
+  sed -n '/BENCHMARK_RESULT_JSON_START/,/BENCHMARK_RESULT_JSON_END/p' "$log" \
+    | sed '1d;$d' > "$out/result.json"
+  if [ ! -s "$out/result.json" ]; then
+    echo "ERROR: no result JSON found in $log" >&2
+    rm -f "$out/result.json"
+    return 1
+  fi
+  echo "Extracted $out/result.json"
+}
+
+case "${1:-}" in
+  --log)
+    [ $# -eq 3 ] || usage
+    extract "$2" "$3"
+    ;;
+  --k8s)
+    [ $# -eq 4 ] || usage
+    NS="$2"; JOB="$3"; OUT="$4"
+    POD=$(kubectl -n "$NS" get pods -l "job-name=$JOB" \
+          -o jsonpath='{.items[0].metadata.name}')
+    if [ -z "$POD" ]; then echo "ERROR: no pod for job $JOB" >&2; exit 1; fi
+    PHASE=$(kubectl -n "$NS" get pod "$POD" -o jsonpath='{.status.phase}')
+    echo "Pod $POD phase: $PHASE"
+    mkdir -p "$OUT"
+    kubectl -n "$NS" logs "$POD" > "$OUT/$JOB.log"
+    extract "$OUT/$JOB.log" "$OUT/${JOB}_results"
+    ;;
+  *) usage ;;
+esac

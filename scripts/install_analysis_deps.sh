@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Install analysis deps on the operator VM (parity: reference
+# scripts/install_analysis_deps.sh). The analysis pipeline (parse/plot/report)
+# runs outside containers and needs only pandas/matplotlib/numpy.
+set -euo pipefail
+pip3 install --user pandas matplotlib numpy
+echo "Analysis dependencies installed."

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Launch a multi-chip TPU benchmark job on Kubernetes.
+#
+# Parity with reference scripts/launch_multi.sh (arg parse, sed-substitute
+# {{VARS}} into the job template, kubectl apply), with the master/worker
+# template pair collapsed into one symmetric Indexed Job.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+STRATEGY="ddp"
+WORLD_SIZE=8
+NUM_HOSTS=1
+SEQ_LEN=2048
+TIER="A"
+STEPS=100
+PER_DEVICE_BATCH=1
+GRAD_ACCUM=4
+IMAGE="tpu-llm-bench:latest"
+TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
+NAMESPACE="bench"
+
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --strategy) STRATEGY="$2"; shift 2 ;;
+    --world-size) WORLD_SIZE="$2"; shift 2 ;;
+    --num-hosts) NUM_HOSTS="$2"; shift 2 ;;
+    --seq-len) SEQ_LEN="$2"; shift 2 ;;
+    --tier) TIER="$2"; shift 2 ;;
+    --steps) STEPS="$2"; shift 2 ;;
+    --per-device-batch) PER_DEVICE_BATCH="$2"; shift 2 ;;
+    --grad-accum) GRAD_ACCUM="$2"; shift 2 ;;
+    --image) IMAGE="$2"; shift 2 ;;
+    --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
+    *) echo "unknown flag $1"; exit 1 ;;
+  esac
+done
+
+if [ "$WORLD_SIZE" -lt 1 ]; then
+  echo "ERROR: --world-size must be >= 1"; exit 1
+fi
+TPU_PER_HOST=$(( WORLD_SIZE / NUM_HOSTS ))
+if [ $(( TPU_PER_HOST * NUM_HOSTS )) -ne "$WORLD_SIZE" ]; then
+  echo "ERROR: world-size $WORLD_SIZE not divisible by num-hosts $NUM_HOSTS"; exit 1
+fi
+
+echo "Launching: strategy=$STRATEGY world_size=$WORLD_SIZE hosts=$NUM_HOSTS"
+kubectl apply -f k8s/namespace.yaml
+kubectl apply -f k8s/serviceaccount.yaml
+kubectl apply -f k8s/service-coordinator.yaml
+
+sed -e "s|{{STRATEGY}}|$STRATEGY|g" \
+    -e "s|{{WORLD_SIZE}}|$WORLD_SIZE|g" \
+    -e "s|{{NUM_HOSTS}}|$NUM_HOSTS|g" \
+    -e "s|{{TPU_PER_HOST}}|$TPU_PER_HOST|g" \
+    -e "s|{{SEQ_LEN}}|$SEQ_LEN|g" \
+    -e "s|{{TIER}}|$TIER|g" \
+    -e "s|{{STEPS}}|$STEPS|g" \
+    -e "s|{{PER_DEVICE_BATCH}}|$PER_DEVICE_BATCH|g" \
+    -e "s|{{GRAD_ACCUM}}|$GRAD_ACCUM|g" \
+    -e "s|{{IMAGE}}|$IMAGE|g" \
+    -e "s|{{TPU_ACCELERATOR}}|$TPU_ACCELERATOR|g" \
+    -e "s|{{TPU_TOPOLOGY}}|$TPU_TOPOLOGY|g" \
+    k8s/job-benchmark.template.yaml | kubectl apply -f -
+
+echo "Job applied. Watch: kubectl -n $NAMESPACE get pods -w"

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Launch the 1-chip smoke job (parity: reference scripts/launch_smoke.sh —
+# dry-run render, image swap, apply).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+IMAGE="${1:-tpu-llm-bench:latest}"
+
+kubectl apply -f k8s/namespace.yaml
+kubectl apply -f k8s/serviceaccount.yaml
+sed "s|SMOKE_IMAGE_PLACEHOLDER|$IMAGE|" k8s/job-smoke-1chip.yaml | kubectl apply -f -
+echo "Smoke job applied. Logs: kubectl -n bench logs -f job/tpu-bench-smoke"

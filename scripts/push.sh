@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Push the benchmark image to a registry (parity: reference scripts/push.sh).
+set -euo pipefail
+
+IMAGE="${IMAGE:-tpu-llm-bench:latest}"
+REGISTRY="${REGISTRY:-}"
+
+if [ -n "$REGISTRY" ]; then
+  docker tag "$IMAGE" "$REGISTRY/$IMAGE"
+  docker push "$REGISTRY/$IMAGE"
+  echo "Pushed $REGISTRY/$IMAGE"
+else
+  docker push "$IMAGE"
+  echo "Pushed $IMAGE"
+fi

@@ -1,0 +1,128 @@
+#!/usr/bin/env bash
+# Full benchmark suite: strategy x chip-count matrix -> results -> analysis.
+#
+# Suite-orchestrator parity with the reference (scripts/run_all_benchmarks.sh
+# there: fixed matrix, per-run launch/wait/collect/cleanup, then
+# parse -> plot -> report), redesigned for TPU:
+#   - local mode (default): one host with N chips; each arm runs as a local
+#     process over a world_size-chip mesh. Includes world_size=1 so scaling
+#     efficiency is measured against a true single-chip baseline (the
+#     reference's minimum was 2, pinning those rows at 50%).
+#   - --k8s mode: kubectl-driven TPU pod-slice jobs via launch_multi.sh.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+REPO_ROOT="$(pwd)"
+
+MODE="local"
+RESULTS_DIR="${RESULTS_DIR:-$REPO_ROOT/results}"
+TIER="${TIER:-A}"
+SEQ_LEN="${SEQ_LEN:-2048}"
+STEPS="${STEPS:-100}"
+WARMUP_STEPS="${WARMUP_STEPS:-5}"
+PER_DEVICE_BATCH="${PER_DEVICE_BATCH:-1}"
+GRAD_ACCUM="${GRAD_ACCUM:-4}"
+STRATEGIES="${STRATEGIES:-ddp fsdp zero2 zero3}"
+WORLD_SIZES="${WORLD_SIZES:-}"
+NAMESPACE="${NAMESPACE:-bench}"
+IMAGE="${IMAGE:-}"
+TIMEOUT_PER_RUN="${TIMEOUT_PER_RUN:-1800}"
+
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --k8s) MODE="k8s"; shift ;;
+    --tier) TIER="$2"; shift 2 ;;
+    --seq-len) SEQ_LEN="$2"; shift 2 ;;
+    --steps) STEPS="$2"; shift 2 ;;
+    --results-dir) RESULTS_DIR="$2"; shift 2 ;;
+    --image) IMAGE="$2"; shift 2 ;;
+    *) echo "unknown flag $1"; exit 1 ;;
+  esac
+done
+
+mkdir -p "$RESULTS_DIR"
+
+if [ -z "$WORLD_SIZES" ]; then
+  if [ "$MODE" = "local" ]; then
+    NCHIPS=$(python -c "
+from distributed_llm_training_benchmark_framework_tpu.utils.platform import honor_jax_platforms_env
+honor_jax_platforms_env()
+import jax; print(jax.device_count())" 2>/dev/null || echo 1)
+    WORLD_SIZES="1"
+    for ws in 2 4 8; do [ "$ws" -le "$NCHIPS" ] && WORLD_SIZES="$WORLD_SIZES $ws"; done
+  else
+    WORLD_SIZES="1 2 4 8"
+  fi
+fi
+
+echo "=== TPU Benchmark Suite ==="
+echo "mode=$MODE strategies=[$STRATEGIES] world_sizes=[$WORLD_SIZES]"
+echo "tier=$TIER seq=$SEQ_LEN steps=$STEPS batch=$PER_DEVICE_BATCH accum=$GRAD_ACCUM"
+echo ""
+
+PASS=0; FAIL=0
+SUITE_START=$(date +%s)
+
+run_local() {
+  local strategy="$1" ws="$2"
+  local name="bench-${strategy}-ws${ws}-seq${SEQ_LEN}"
+  local log="$RESULTS_DIR/${name}.log"
+  echo "--- $name ---"
+  local t0=$(date +%s)
+  if timeout "$TIMEOUT_PER_RUN" python -u benchmarking/train_harness.py \
+      --strategy "$strategy" --world-size "$ws" --rank 0 \
+      --tier "$TIER" --seq-len "$SEQ_LEN" \
+      --steps "$STEPS" --warmup-steps "$WARMUP_STEPS" \
+      --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
+      --results-dir "$RESULTS_DIR/${name}_results" \
+      > "$log" 2>&1; then
+    scripts/collect_results.sh --log "$log" "$RESULTS_DIR/${name}_results" \
+      || true
+    echo "OK ($(( $(date +%s) - t0 ))s)"
+    PASS=$((PASS+1))
+  else
+    echo "FAILED — last 20 log lines:"
+    tail -20 "$log" || true
+    FAIL=$((FAIL+1))
+  fi
+}
+
+run_k8s() {
+  local strategy="$1" ws="$2"
+  local name="bench-${strategy}-ws${ws}-seq${SEQ_LEN}"
+  echo "--- $name (k8s) ---"
+  scripts/launch_multi.sh --strategy "$strategy" --world-size "$ws" \
+    --seq-len "$SEQ_LEN" --tier "$TIER" --steps "$STEPS" \
+    --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
+    ${IMAGE:+--image "$IMAGE"}
+  if kubectl -n "$NAMESPACE" wait --for=condition=complete \
+       "job/tpu-bench" --timeout=900s; then
+    scripts/collect_results.sh --k8s "$NAMESPACE" "tpu-bench" "$RESULTS_DIR"
+    PASS=$((PASS+1))
+  else
+    echo "FAILED — last 100 log lines:"
+    kubectl -n "$NAMESPACE" logs -l job-name=tpu-bench --tail=100 || true
+    FAIL=$((FAIL+1))
+  fi
+  kubectl -n "$NAMESPACE" delete job tpu-bench --ignore-not-found
+}
+
+for strategy in $STRATEGIES; do
+  for ws in $WORLD_SIZES; do
+    if [ "$MODE" = "local" ]; then run_local "$strategy" "$ws"; else run_k8s "$strategy" "$ws"; fi
+  done
+done
+
+echo ""
+echo "=== Analysis ==="
+SUMMARY="$RESULTS_DIR/summary"
+python -m distributed_llm_training_benchmark_framework_tpu.analysis.parse_metrics \
+  --results-dir "$RESULTS_DIR" --out "$SUMMARY"
+python -m distributed_llm_training_benchmark_framework_tpu.analysis.plot \
+  --results "$SUMMARY/metrics.csv" --out "$RESULTS_DIR/plots"
+python -m distributed_llm_training_benchmark_framework_tpu.analysis.make_report \
+  --csv "$SUMMARY/metrics.csv" --out "$SUMMARY" --plots-dir ../plots
+
+echo ""
+echo "=== Suite complete: $PASS passed, $FAIL failed, $(( $(date +%s) - SUITE_START ))s total ==="
+[ "$FAIL" -eq 0 ]

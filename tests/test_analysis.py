@@ -1,0 +1,143 @@
+"""Analysis pipeline tests: parse -> metrics.csv -> plots -> report.
+
+Golden checks for the scaling-efficiency formula (reference
+``scripts/parse_metrics.py:50-63``) including the published-quirk case where
+the baseline world size is 2 (rows pinned at 50%) and the honest ws=1 case.
+"""
+
+import json
+import os
+
+import pandas as pd
+import pytest
+
+from distributed_llm_training_benchmark_framework_tpu.analysis import (
+    parse_metrics,
+    make_report,
+)
+from distributed_llm_training_benchmark_framework_tpu.analysis import plot as plot_mod
+
+
+def result(strategy="ddp", ws=1, tps=1000.0, seq=2048, **kw):
+    r = {
+        "strategy": strategy, "world_size": ws, "rank": 0, "seq_len": seq,
+        "tier": "A", "steps": 100, "per_device_batch": 1, "grad_accum": 4,
+        "tokens_per_sec": tps, "mean_step_time_sec": 0.5, "mean_loss": 6.1,
+        "peak_vram_gb": 10.0, "h2d_gbps_per_gpu": 1e-5,
+    }
+    r.update(kw)
+    return r
+
+
+def write_results(tmp_path, results):
+    for i, r in enumerate(results):
+        d = tmp_path / f"run{i}_results"
+        d.mkdir(exist_ok=True)
+        (d / "result.json").write_text(json.dumps(r))
+
+
+def test_scaling_efficiency_with_ws1_baseline(tmp_path):
+    write_results(tmp_path, [
+        result(ws=1, tps=1000.0),
+        result(ws=4, tps=3600.0),
+        result(ws=8, tps=7200.0),
+    ])
+    df = parse_metrics.add_scaling_efficiency(parse_metrics.load_results(str(tmp_path)))
+    by_ws = df.set_index("world_size")["scaling_efficiency_pct"]
+    assert by_ws[1] == pytest.approx(100.0)
+    assert by_ws[4] == pytest.approx(90.0)
+    assert by_ws[8] == pytest.approx(90.0)
+
+
+def test_scaling_efficiency_reference_quirk_ws2_baseline(tmp_path):
+    """With min world size 2 the formula pins baseline rows at 50% — exactly
+    the published reference behavior (README.md:216-223)."""
+    write_results(tmp_path, [
+        result(ws=2, tps=8369.0),
+        result(ws=4, tps=12220.0),
+    ])
+    df = parse_metrics.add_scaling_efficiency(parse_metrics.load_results(str(tmp_path)))
+    by_ws = df.set_index("world_size")["scaling_efficiency_pct"]
+    assert by_ws[2] == pytest.approx(50.0)
+    assert by_ws[4] == pytest.approx(12220.0 / (8369.0 * 4) * 100, rel=1e-6)
+
+
+def test_groups_are_independent(tmp_path):
+    write_results(tmp_path, [
+        result("ddp", ws=1, tps=1000.0),
+        result("ddp", ws=8, tps=4000.0),
+        result("zero2", ws=1, tps=2000.0),
+        result("zero2", ws=8, tps=16000.0),
+    ])
+    df = parse_metrics.add_scaling_efficiency(parse_metrics.load_results(str(tmp_path)))
+    z2 = df[(df.strategy == "zero2") & (df.world_size == 8)]
+    assert z2["scaling_efficiency_pct"].iloc[0] == pytest.approx(100.0)
+    ddp = df[(df.strategy == "ddp") & (df.world_size == 8)]
+    assert ddp["scaling_efficiency_pct"].iloc[0] == pytest.approx(50.0)
+
+
+def test_csv_column_contract(tmp_path):
+    write_results(tmp_path, [result()])
+    df = parse_metrics.add_scaling_efficiency(parse_metrics.load_results(str(tmp_path)))
+    out = tmp_path / "summary" / "metrics.csv"
+    parse_metrics.to_csv(df, str(out))
+    got = pd.read_csv(out)
+    # Reference columns lead, in reference order; efficiency column last.
+    assert list(got.columns[:13]) == parse_metrics.REFERENCE_COLUMNS
+    assert got.columns[-1] == "scaling_efficiency_pct"
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    write_results(tmp_path, [result(ws=1), result(ws=4, tps=3500.0)])
+    out = tmp_path / "summary"
+    rc = parse_metrics.main(["--results-dir", str(tmp_path), "--out", str(out)])
+    assert rc == 0
+    assert (out / "metrics.csv").exists()
+
+
+def test_plots_written(tmp_path):
+    write_results(tmp_path, [
+        result(ws=1), result(ws=4, tps=3500.0),
+        result("zero2", ws=1, tps=1200.0), result("zero2", ws=4, tps=4500.0),
+    ])
+    df = parse_metrics.add_scaling_efficiency(parse_metrics.load_results(str(tmp_path)))
+    plots = tmp_path / "plots"
+    written = plot_mod.make_plots(df, str(plots))
+    assert "tokens_per_sec_vs_gpu.png" in written
+    assert "scaling_efficiency.png" in written
+    for name in written:
+        assert (plots / name).stat().st_size > 1000
+
+
+def test_plot_seqlen_figure_only_with_multiple_seqlens(tmp_path):
+    write_results(tmp_path, [result(seq=2048), result(seq=4096, ws=1)])
+    df = parse_metrics.add_scaling_efficiency(parse_metrics.load_results(str(tmp_path)))
+    written = plot_mod.make_plots(df, str(tmp_path / "plots"))
+    assert "vram_vs_seqlen.png" in written
+
+
+def test_report_generation(tmp_path):
+    write_results(tmp_path, [
+        result(ws=1), result(ws=4, tps=3500.0),
+        result("zero2", ws=4, tps=4500.0, peak_vram_gb=8.0),
+    ])
+    df = parse_metrics.add_scaling_efficiency(parse_metrics.load_results(str(tmp_path)))
+    report = make_report.build_report(df)
+    assert "# TPU Distributed Training Benchmark Report" in report
+    assert "Best throughput:" in report and "zero2" in report
+    assert "scaling_efficiency.png" in report
+
+
+def test_duplicate_results_deduped(tmp_path):
+    """The harness-written and log-scraped copies of one run count once."""
+    write_results(tmp_path, [result(ws=4, tps=3500.0)])
+    d = tmp_path / "scraped"
+    d.mkdir()
+    (d / "result.json").write_text(json.dumps(result(ws=4, tps=3500.0)))
+    df = parse_metrics.load_results(str(tmp_path))
+    assert len(df) == 1
+
+
+def test_empty_results_dir_errors(tmp_path):
+    with pytest.raises(SystemExit):
+        parse_metrics.load_results(str(tmp_path))

@@ -1,0 +1,157 @@
+"""Strategy-arm tests on an 8-device virtual CPU mesh.
+
+What the reference could never test without a GPU cluster (SURVEY §4): that
+each strategy arm actually runs multi-device, that its sharding layout is what
+the strategy promises (DDP replicated / FSDP sharded / ZeRO-2 sharded moments
+with replicated params), and that all four arms compute the *same* training
+trajectory at fixed seed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_llm_training_benchmark_framework_tpu.models import get_model_config
+from distributed_llm_training_benchmark_framework_tpu.parallel import (
+    make_mesh,
+    get_strategy,
+    STRATEGIES,
+)
+from distributed_llm_training_benchmark_framework_tpu.train import create_train_state
+from distributed_llm_training_benchmark_framework_tpu.data import SyntheticDataset
+
+ARMS = sorted(STRATEGIES)
+
+
+def make_state(strategy_name, n_devices=8, grad_accum=1, **cfg_kw):
+    cfg_kw.setdefault("dropout", 0.0)
+    cfg = get_model_config("S", 64, **cfg_kw)
+    mesh = make_mesh((n_devices,), ("data",), devices=jax.devices()[:n_devices])
+    return create_train_state(
+        cfg, get_strategy(strategy_name), mesh, seed=42, grad_accum=grad_accum
+    )
+
+
+def run_steps(state, n_steps, global_batch=8, grad_accum=1, seq=64):
+    ds = SyntheticDataset(vocab_size=512, seq_len=seq, size=64)
+    losses = []
+    params, opt = state.params, state.opt_state
+    for step in range(n_steps):
+        batch = ds.batch_for_step(step, global_batch * grad_accum)
+        batch = batch.reshape(grad_accum, global_batch, seq)
+        batch = jax.device_put(batch, state.batch_sharding)
+        params, opt, loss = state.step_fn(params, opt, batch, step)
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("arm", ARMS)
+def test_arm_runs_multidevice(arm, eight_devices):
+    state = make_state(arm)
+    losses = run_steps(state, 3)
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[0] > 4.0  # ~ln(512)=6.2 at init
+
+
+def test_ddp_params_replicated(eight_devices):
+    state = make_state("ddp")
+    for spec in jax.tree_util.tree_leaves(
+        jax.tree.map(lambda s: tuple(s), state.param_specs,
+                     is_leaf=lambda x: isinstance(x, P))
+    ):
+        assert spec is None or spec == (), spec
+
+
+def test_fsdp_params_sharded(eight_devices):
+    state = make_state("fsdp")
+    # Large leaves must actually be sharded: check the embedding table.
+    wte = state.params["wte"]
+    assert len(wte.sharding.device_set) == 8
+    shard_shape = wte.sharding.shard_shape(wte.shape)
+    assert np.prod(shard_shape) == np.prod(wte.shape) // 8
+
+
+def test_zero2_layout(eight_devices):
+    """The defining ZeRO-2 layout: replicated params, sharded Adam moments."""
+    state = make_state("zero2")
+    wte = state.params["wte"]
+    assert wte.sharding.shard_shape(wte.shape) == wte.shape  # replicated
+    # Find the Adam mu tree inside the optax state and check sharding.
+    import optax
+
+    mus = [
+        s.mu for s in jax.tree_util.tree_leaves(
+            state.opt_state, is_leaf=lambda x: hasattr(x, "mu")
+        ) if hasattr(s, "mu")
+    ]
+    assert mus, "no Adam state found"
+    mu_wte = mus[0]["wte"]
+    shard = mu_wte.sharding.shard_shape(mu_wte.shape)
+    assert np.prod(shard) == np.prod(mu_wte.shape) // 8  # sharded moments
+
+
+def test_zero3_remat_enabled(eight_devices):
+    state = make_state("zero3")
+    assert state.model_config.remat is True
+    wte = state.params["wte"]
+    assert np.prod(wte.sharding.shard_shape(wte.shape)) == np.prod(wte.shape) // 8
+
+
+def test_loss_parity_across_arms(eight_devices):
+    """Same seed, same data, same optimizer recipe => same trajectory.
+
+    This is the semantic heart of the framework: a strategy changes WHERE
+    arrays live and WHICH collectives run, never WHAT is computed. The arms
+    pair up by optimizer recipe — ddp/fsdp share bare AdamW, zero2/zero3 share
+    AdamW + WarmupLR(5) + clip 1.0 (exactly as in the reference, where the
+    DeepSpeed arms run a different recipe than the torch arms).
+    """
+    trajectories = {arm: run_steps(make_state(arm), 4) for arm in ARMS}
+    np.testing.assert_allclose(
+        trajectories["fsdp"], trajectories["ddp"], rtol=2e-3, err_msg="fsdp vs ddp"
+    )
+    np.testing.assert_allclose(
+        trajectories["zero3"], trajectories["zero2"], rtol=2e-3, err_msg="zero3 vs zero2"
+    )
+    # All arms start from identical params => identical first loss.
+    first = [t[0] for t in trajectories.values()]
+    np.testing.assert_allclose(first, first[0], rtol=1e-4)
+    # The warmup recipe must actually differ from the bare recipe by step 2.
+    assert abs(trajectories["zero2"][2] - trajectories["ddp"][2]) > 1e-4
+
+
+def test_grad_accum_matches_large_batch(eight_devices):
+    """accum=2 x batch=8 must track accum=1 x batch=16 (real accumulation)."""
+    s1 = make_state("ddp", grad_accum=1)
+    l1 = run_steps(s1, 3, global_batch=16, grad_accum=1)
+    s2 = make_state("ddp", grad_accum=2)
+    l2 = run_steps(s2, 3, global_batch=8, grad_accum=2)
+    np.testing.assert_allclose(l1, l2, rtol=2e-3)
+
+
+def test_single_device_mesh_works():
+    """world_size==1 smoke path (reference skips dist init entirely there)."""
+    state = make_state("ddp", n_devices=1)
+    losses = run_steps(state, 2, global_batch=2)
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_strategy_config_files_load():
+    import glob
+    import os
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        load_strategy_config,
+    )
+
+    root = os.path.join(os.path.dirname(__file__), "..", "configs", "strategies")
+    files = sorted(glob.glob(os.path.join(root, "*.json")))
+    assert len(files) >= 4, "expected ddp/fsdp/zero2/zero3 configs"
+    names = set()
+    for f in files:
+        sc = load_strategy_config(f)
+        names.add(sc.name)
+        assert sc.learning_rate > 0
+    assert {"ddp", "fsdp", "zero2", "zero3"} <= names
