@@ -33,6 +33,13 @@ def main():
     p.add_argument("--grad-accum", type=int, default=4)
     p.add_argument("--world-size", type=int, default=None,
                    help="default: all visible devices")
+    # flash is the headline config: same model/loss/optimizer/data as the
+    # parity setup; the Pallas kernel omits only attention-probability dropout
+    # (documented deviation — the probabilities never materialize). Pass
+    # --attention reference for the exact-reference-semantics run.
+    p.add_argument("--attention", default="flash",
+                   choices=["reference", "flash", "ring"])
+    p.add_argument("--dropout", type=float, default=None)
     args = p.parse_args()
 
     from distributed_llm_training_benchmark_framework_tpu.utils.platform import (
@@ -60,6 +67,8 @@ def main():
             grad_accum=args.grad_accum,
             world_size=world,
             results_dir=None,
+            attention_impl=args.attention,
+            dropout=args.dropout,
         )
 
     per_chip = result.tokens_per_sec / world

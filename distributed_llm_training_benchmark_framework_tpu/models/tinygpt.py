@@ -98,8 +98,11 @@ PARAM_AXIS_RULES: Dict[str, Tuple[Optional[str], ...]] = {
     "wpe": ("pos", "embed"),
     "blocks/ln1_scale": ("layers", "embed"),
     "blocks/ln1_bias": ("layers", "embed"),
-    "blocks/wqkv": ("layers", "embed", "qkv"),
-    "blocks/bqkv": ("layers", "qkv"),
+    # qkv is stored (layers, embed, 3, heads*head_dim) — the q/k/v axis is its
+    # own dimension so sharding 'heads' on a tensor-parallel mesh axis never
+    # crosses a q/k/v boundary.
+    "blocks/wqkv": ("layers", "embed", "qkv3", "heads"),
+    "blocks/bqkv": ("layers", "qkv3", "heads"),
     "blocks/wo": ("layers", "heads_merged", "embed"),
     "blocks/bo": ("layers", "embed"),
     "blocks/ln2_scale": ("layers", "embed"),
@@ -137,8 +140,8 @@ def init_params(config: TinyGPTConfig, key: jax.Array) -> Params:
         "blocks": {
             "ln1_scale": ones((L, D)),
             "ln1_bias": zeros((L, D)),
-            "wqkv": normal(next(k), (L, D, 3 * D)),
-            "bqkv": zeros((L, 3 * D)),
+            "wqkv": normal(next(k), (L, D, 3, D)),
+            "bqkv": zeros((L, 3, D)),
             "wo": normal(next(k), (L, D, D)),
             "bo": zeros((L, D)),
             "ln2_scale": ones((L, D)),
@@ -237,13 +240,13 @@ def _block(
     # --- attention sublayer ---
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
     qkv = (
-        jnp.einsum("bsd,de->bse", h, layer["wqkv"].astype(cd), preferred_element_type=jnp.float32)
+        jnp.einsum("bsd,dce->bsce", h, layer["wqkv"].astype(cd), preferred_element_type=jnp.float32)
         .astype(cd)
         + layer["bqkv"].astype(cd)
-    )
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    )  # (B, S, 3, D)
     to_heads = lambda t: t.reshape(B, S, c.n_head, c.head_dim)
-    attn = _attention(c, to_heads(q), to_heads(k), to_heads(v), keys[0], deterministic)
+    q, k, v = (to_heads(qkv[:, :, i]) for i in range(3))
+    attn = _attention(c, q, k, v, keys[0], deterministic)
     attn = attn.reshape(B, S, D)
     attn = (
         jnp.einsum("bsd,de->bse", attn, layer["wo"].astype(cd), preferred_element_type=jnp.float32)
@@ -269,6 +272,68 @@ def _block(
     return x + h
 
 
+def embed(
+    config: TinyGPTConfig,
+    params: Params,
+    idx: jax.Array,  # (B, S) int32
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Token + positional embedding -> dropout -> (B, S, D) compute dtype."""
+    c = config
+    S = idx.shape[1]
+    tok = jnp.take(params["wte"], idx, axis=0)
+    pos = params["wpe"][:S]
+    x = (tok + pos[None, :, :]).astype(c.compute_dtype)
+    if dropout_key is not None and not deterministic:
+        x = _dropout(x, c.dropout, dropout_key, deterministic)
+    return x
+
+
+def apply_blocks(
+    config: TinyGPTConfig,
+    blocks: Params,  # stacked block params, leading 'layers' axis (may be a slice)
+    x: jax.Array,  # (B, S, D) compute dtype
+    base_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    layer_offset: int = 0,
+) -> jax.Array:
+    """Scan the given stacked blocks over x.
+
+    ``layer_offset`` keeps per-layer dropout keys globally consistent when the
+    stack is a pipeline stage's slice: layer i's key is fold_in(base_key,
+    layer_offset + i) regardless of which stage runs it.
+    """
+    c = config
+    block = functools.partial(_block, c, deterministic=deterministic)
+    if c.remat:
+        block = jax.checkpoint(block)
+
+    if base_key is None or deterministic:
+        scan_body = lambda carry, layer: (block(carry, layer, None), None)
+        x, _ = lax.scan(scan_body, x, blocks)
+    else:
+        n_local = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+        idxs = jnp.arange(n_local) + layer_offset
+        scan_body = lambda carry, li: (
+            block(carry, li[0], jax.random.fold_in(base_key, li[1])),
+            None,
+        )
+        x, _ = lax.scan(scan_body, x, (blocks, idxs))
+    return x
+
+
+def head(config: TinyGPTConfig, params: Params, x: jax.Array) -> jax.Array:
+    """Final LN + weight-tied LM head -> fp32 logits (B, S, V)."""
+    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    return jnp.einsum(
+        "bsd,vd->bsv",
+        x,
+        params["wte"].astype(config.compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+
 def forward(
     config: TinyGPTConfig,
     params: Params,
@@ -283,42 +348,23 @@ def forward(
     Structure parity: reference ``TinyGPT.forward`` (train_harness.py:80-105):
     tok_emb + pos_emb -> dropout -> blocks -> ln_f -> tied lm_head ->
     cross-entropy(ignore_index=-1). The layer loop is a ``lax.scan`` over
-    stacked weights (single compiled block body; optional per-layer remat).
+    stacked weights (single compiled block body; optional per-layer remat);
+    the embed/apply_blocks/head pieces are reused by the pipeline-parallel
+    schedule (parallel.pipeline), which runs them stage-by-stage.
     """
     c = config
     B, S = idx.shape
     if S > c.block_size:
         raise ValueError(f"Sequence {S} exceeds block size {c.block_size}")
-    cd = c.compute_dtype
-
-    tok = jnp.take(params["wte"], idx, axis=0)
-    pos = params["wpe"][:S]
-    x = (tok + pos[None, :, :]).astype(cd)
 
     if dropout_key is not None and not deterministic:
         emb_key, scan_key = jax.random.split(dropout_key)
-        x = _dropout(x, c.dropout, emb_key, deterministic)
-        layer_keys = jax.random.split(scan_key, c.n_layer)
     else:
-        layer_keys = None
+        emb_key = scan_key = None
 
-    block = functools.partial(_block, c, deterministic=deterministic)
-    if c.remat:
-        block = jax.checkpoint(block)
-
-    if layer_keys is None:
-        scan_body = lambda carry, layer: (block(carry, layer, None), None)
-        xs = params["blocks"]
-    else:
-        scan_body = lambda carry, lk: (block(carry, lk[0], lk[1]), None)
-        xs = (params["blocks"], layer_keys)
-    x, _ = lax.scan(scan_body, x, xs)
-
-    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
-    # Weight-tied LM head: logits = x @ wte^T, fp32 accumulation on the MXU.
-    logits = jnp.einsum(
-        "bsd,vd->bsv", x, params["wte"].astype(cd), preferred_element_type=jnp.float32
-    )
+    x = embed(c, params, idx, emb_key, deterministic)
+    x = apply_blocks(c, params["blocks"], x, scan_key, deterministic)
+    logits = head(c, params, x)
 
     loss = None
     if targets is not None:

@@ -111,16 +111,22 @@ def ring_attention(
     with attention_impl='ring' still run on a plain data mesh)."""
     if mesh is None:
         m = jax.sharding.get_abstract_mesh()
-        mesh = m if m is not None and axis_name in m.axis_names else None
+        mesh = m if m is not None and axis_name in getattr(m, "axis_names", ()) else None
     if mesh is None or mesh.shape.get(axis_name, 1) == 1:
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)
 
+    # Compose with whatever other parallelism the mesh carries: batch stays
+    # sharded on 'data', heads stay sharded on 'model' (tensor parallel) —
+    # the ring only ever communicates along the 'seq' axis.
+    batch_ax = "data" if mesh.shape.get("data", 1) > 1 else None
+    model_ax = "model" if mesh.shape.get("model", 1) > 1 else None
+    spec = P(batch_ax, axis_name, model_ax, None)
     fn = jax.shard_map(
         functools.partial(ring_attention_sharded, axis_name=axis_name, causal=causal),
         mesh=mesh,
-        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
-        out_specs=P(None, axis_name),
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
     )
     return fn(q, k, v)

@@ -1,0 +1,149 @@
+"""Pipeline parallelism — GPipe microbatch schedule over a 'pipe' mesh axis.
+
+Absent from the reference (SURVEY §2.3: PP is future-work prose in its README
+only). TPU-native design: the stacked layer weights are sharded on their
+leading 'layers' axis across the 'pipe' mesh axis (L/P contiguous layers per
+stage), and activations flow stage-to-stage via ``ppermute`` on neighbor ICI
+links. The schedule is the classic GPipe fill-drain: with M microbatches and
+P stages, T = M + P - 1 ticks; at tick t stage s runs microbatch t - s.
+
+Implementation notes:
+- runs inside ``jax.shard_map`` manual ONLY over 'pipe' (``axis_names``):
+  the 'data'/'model' axes stay auto, so data-parallel batch sharding and
+  Megatron tensor parallelism compose with the pipeline for free;
+- embeddings, final LN and the tied LM head are replicated across stages;
+  every stage computes the (cheap) embed/head for schedule uniformity and a
+  predicate selects the real producer — the fill/drain bubble, not this, is
+  the dominant overhead;
+- the whole schedule is differentiable (``ppermute`` transposes to the
+  reverse permutation), so one ``jax.value_and_grad`` around the pipelined
+  loss drives the backward schedule automatically;
+- microbatches double as gradient accumulation: the step's (accum, batch,
+  seq) input feeds the pipeline as its M microbatches.
+
+Constraint: n_layer % pipe == 0; ring (sequence-parallel) attention does not
+compose with the pipeline in this version (nested manual axes) — use
+dp/tp/pp.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models import tinygpt
+
+AXIS = "pipe"
+
+
+def pipeline_param_specs(params, mesh: Mesh):
+    """Manual-axis ('pipe'-only) specs: block stacks sharded on layers axis."""
+
+    def spec(path, leaf):
+        is_block = any(getattr(p, "key", None) == "blocks" for p in path)
+        if is_block:
+            return P(AXIS, *([None] * (leaf.ndim - 1)))
+        return P(*([None] * leaf.ndim))
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def pipeline_loss_fn(
+    config: tinygpt.TinyGPTConfig,
+    mesh: Mesh,
+    params,
+    batch: jax.Array,  # (M, mb, S) microbatches; targets are the inputs
+    base_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> jax.Array:
+    """Mean loss over M microbatches, computed on the GPipe schedule."""
+    n_stages = mesh.shape[AXIS]
+    if config.n_layer % n_stages != 0:
+        raise ValueError(
+            f"n_layer={config.n_layer} not divisible by pipe={n_stages}"
+        )
+    layers_per_stage = config.n_layer // n_stages
+    n_micro = batch.shape[0]
+    ticks = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def staged(params, batch):
+        stage = lax.axis_index(AXIS)
+        blocks = params["blocks"]  # local slice: (L/P, ...)
+        mb, S = batch.shape[1], batch.shape[2]
+        D = config.n_embd
+        state = jnp.zeros((mb, S, D), config.compute_dtype)
+        loss_sum = jnp.zeros((), jnp.float32)
+
+        emb_key = (
+            jax.random.fold_in(base_key, 1_000_003) if base_key is not None else None
+        )
+        offset = stage * layers_per_stage
+
+        for t in range(ticks):
+            # Stage 0 ingests a fresh microbatch while the schedule is filling;
+            # downstream stages consume what the previous tick permuted in.
+            if t < n_micro:
+                ek = (
+                    jax.random.fold_in(emb_key, t)
+                    if emb_key is not None and not deterministic
+                    else None
+                )
+                inject = tinygpt.embed(config, params, batch[t], ek, deterministic)
+                state_in = jnp.where(stage == 0, inject, state)
+            else:
+                state_in = state
+            bk = (
+                jax.random.fold_in(base_key, t)
+                if base_key is not None and not deterministic
+                else None
+            )
+            state_out = tinygpt.apply_blocks(
+                config, blocks, state_in, bk, deterministic, layer_offset=offset
+            )
+
+            # The last stage drains: at tick t it finishes microbatch
+            # t - (P-1). The LM head is a (mb,S,D)x(V,D) einsum — layer-scale
+            # compute — so on TPU a cond (legal per-device control flow inside
+            # the manual region) skips it entirely on non-final stages. The
+            # CPU backend compute-and-masks instead: XLA's CPU-only
+            # AllReducePromotion pass aborts on the collectives the cond
+            # lowering produces (same bug class as the pp x tp guard).
+            li = t - (n_stages - 1)
+            if 0 <= li < n_micro:
+                if jax.default_backend() == "cpu":
+                    logits = tinygpt.head(config, params, state_out)
+                    l = tinygpt._cross_entropy(logits, batch[li])
+                    loss_sum = loss_sum + jnp.where(stage == n_stages - 1, l, 0.0)
+                else:
+                    loss_sum = loss_sum + lax.cond(
+                        stage == n_stages - 1,
+                        lambda so=state_out, tgt=batch[li]: tinygpt._cross_entropy(
+                            tinygpt.head(config, params, so), tgt
+                        ),
+                        # pcast marks the zero as device-varying over 'pipe'
+                        # so both branches carry the same manual-axes type.
+                        lambda: lax.pcast(
+                            jnp.zeros((), jnp.float32), (AXIS,), to="varying"
+                        ),
+                    )
+
+            if t < ticks - 1:
+                state = lax.ppermute(state_out, AXIS, perm)
+
+        # Only the last stage accumulated loss; broadcast it to every stage.
+        return lax.psum(loss_sum, AXIS) / n_micro
+
+    fn = jax.shard_map(
+        staged,
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(params, mesh), P()),
+        out_specs=P(),
+        axis_names=frozenset({AXIS}),
+    )
+    return fn(params, batch)

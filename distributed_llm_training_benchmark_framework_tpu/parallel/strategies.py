@@ -178,38 +178,74 @@ def make_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
 # PartitionSpec derivation
 # ---------------------------------------------------------------------------
 
+# Megatron-style tensor-parallel layout over the 'model' mesh axis, keyed by
+# parameter leaf path. Column-parallel QKV/FC1 (output features sharded),
+# row-parallel attention-out/FC2 (input features sharded; XLA inserts the
+# all-reduce the row-parallel matmul needs), vocab-sharded tied embedding
+# (the logits einsum + cross-entropy become Megatron's parallel softmax —
+# GSPMD derives the collectives from the sharding).
+_TP_RULES = {
+    "wte": (0,),        # vocab
+    "blocks/wqkv": (3,),  # per-head output features
+    "blocks/bqkv": (2,),
+    "blocks/wo": (1,),  # row-parallel input (merged heads)
+    "blocks/wfc": (2,),  # column-parallel output
+    "blocks/bfc": (1,),
+    "blocks/wproj": (1,),  # row-parallel input
+}
 
-def _shard_leaf_spec(shape: Tuple[int, ...], n_shards: int, is_block_leaf: bool) -> P:
-    """FSDP-style per-leaf spec: shard the largest divisible axis on 'data'.
+
+def _leaf_name(path) -> str:
+    return "/".join(str(getattr(p, "key", p)) for p in path)
+
+
+def _shard_largest_free_axis(
+    spec: list, shape: Tuple[int, ...], n_shards: int, is_block_leaf: bool
+) -> None:
+    """FSDP-style: put 'data' on the largest unsharded divisible axis.
 
     For stacked block leaves (leading 'layers' scan axis) we prefer a tensor
     axis over the layers axis: sharding inside the layer keeps the scan body's
     dynamic-slice local and lets XLA all-gather exactly one layer's shard per
     scan iteration (the FSDP/ZeRO-3 schedule). The layers axis is the fallback.
     """
-    spec = [None] * len(shape)
     axes = list(range(len(shape)))
     candidates = axes[1:] + axes[:1] if is_block_leaf and len(shape) > 1 else axes
-    # Prefer the largest divisible axis among the candidates.
     best = None
     for ax in candidates:
-        if shape[ax] % n_shards == 0 and shape[ax] >= n_shards:
+        if spec[ax] is None and shape[ax] % n_shards == 0 and shape[ax] >= n_shards:
             if best is None or shape[ax] > shape[best]:
                 best = ax
     if best is not None:
         spec[best] = "data"
-    return P(*spec)
 
 
 def param_partition_specs(params: Params, mesh: Mesh, shard: bool) -> Params:
-    """PartitionSpec pytree for the params under a given strategy."""
-    n = mesh.shape.get("data", 1)
-    if not shard or n == 1:
-        return jax.tree.map(lambda _: P(), params)
+    """PartitionSpec pytree for the params under a given strategy + mesh.
+
+    Applies tensor-parallel rules first (when the mesh has a >1 'model' axis),
+    then — for sharded strategies — FSDP-style 'data' sharding on the largest
+    remaining axis of each leaf. The two compose: a 2-D (data, model) mesh
+    gives e.g. wfc the spec P(None, 'data', 'model').
+    """
+    n_data = mesh.shape.get("data", 1)
+    n_model = mesh.shape.get("model", 1)
+    n_pipe = mesh.shape.get("pipe", 1)
 
     def spec(path, leaf):
-        is_block = any(getattr(p, "key", None) == "blocks" for p in path)
-        return _shard_leaf_spec(leaf.shape, n, is_block)
+        s = [None] * len(leaf.shape)
+        name = _leaf_name(path)
+        is_block = name.startswith("blocks/")
+        if n_pipe > 1 and is_block:
+            # Pipeline stages own contiguous slices of the stacked layers axis.
+            s[0] = "pipe"
+        if n_model > 1:
+            for ax in _TP_RULES.get(name, ()):
+                if leaf.shape[ax] % n_model == 0:
+                    s[ax] = "model"
+        if shard and n_data > 1:
+            _shard_largest_free_axis(s, leaf.shape, n_data, is_block)
+        return P(*s)
 
     return jax.tree_util.tree_map_with_path(spec, params)
 
@@ -243,10 +279,13 @@ def opt_state_partition_specs(
 
 
 def batch_partition_spec(mesh: Mesh) -> P:
-    """Global batch is sharded along its leading (batch) dim on 'data'."""
-    if mesh.shape.get("data", 1) > 1:
-        return P("data")
-    return P()
+    """Global batch (batch, seq): batch dim sharded on 'data', sequence dim on
+    'seq' when a sequence-parallel axis exists (ring attention consumes it)."""
+    batch_axis = "data" if mesh.shape.get("data", 1) > 1 else None
+    seq_axis = "seq" if mesh.shape.get("seq", 1) > 1 else None
+    if seq_axis is None:
+        return P(batch_axis) if batch_axis else P()
+    return P(batch_axis, seq_axis)
 
 
 def named(mesh: Mesh, spec_tree: Any) -> Any:

@@ -1,0 +1,83 @@
+"""Checkpoint / resume via orbax — sharding-aware save/restore.
+
+The reference has no checkpointing at all (SURVEY §5.4: nothing calls save;
+DeepSpeed's gather-on-save knob is dead config; fault tolerance is listed as
+future work in reference ``README.md:1065-1068``). Here it is a real
+subsystem: orbax persists the param + optimizer-state pytrees *with their
+NamedShardings*, so a fully-sharded (fsdp/zero3) tier-B state saves and
+restores without ever materializing a replicated copy, and a resumed run
+continues the step count and LR schedule exactly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+
+
+class BenchmarkCheckpointer:
+    """Thin wrapper over orbax CheckpointManager for (params, opt_state, step)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3, save_every: int = 0):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self.save_every = save_every
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def should_save(self, step: int) -> bool:
+        return self.save_every > 0 and step > 0 and step % self.save_every == 0
+
+    def save(self, step: int, params: Any, opt_state: Any, force: bool = False) -> bool:
+        saved = self.manager.save(
+            step,
+            args=self._ocp.args.Composite(
+                params=self._ocp.args.StandardSave(params),
+                opt_state=self._ocp.args.StandardSave(opt_state),
+            ),
+            force=force,
+        )
+        if saved:
+            self.manager.wait_until_finished()
+        return bool(saved)
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(
+        self, params_template: Any, opt_state_template: Any, step: Optional[int] = None
+    ) -> Tuple[Any, Any, int]:
+        """Restore into the templates' shardings (abstract arrays accepted)."""
+        step = self.manager.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+
+        def as_abstract(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+                if hasattr(x, "sharding") else x,
+                tree,
+            )
+
+        restored = self.manager.restore(
+            step,
+            args=self._ocp.args.Composite(
+                params=self._ocp.args.StandardRestore(as_abstract(params_template)),
+                opt_state=self._ocp.args.StandardRestore(
+                    as_abstract(opt_state_template)
+                ),
+            ),
+        )
+        return restored["params"], restored["opt_state"], step
+
+    def close(self) -> None:
+        self.manager.close()

@@ -1,0 +1,44 @@
+"""Debug mode — the TPU-native analogue of the reference's strict-NCCL flags.
+
+The reference's race/hang defense is environmental: TORCH_DISTRIBUTED_DEBUG=
+DETAIL, TORCH_NCCL_BLOCKING_WAIT=1, NCCL_ASYNC_ERROR_HANDLING=1 baked into the
+image (reference ``docker/Dockerfile:66-72``) turn silent collective
+mismatches into loud errors. JAX's functional model removes data races by
+construction (SURVEY §5.2); what remains worth catching is numerical faults
+(NaNs), leaked tracers, and cross-host coordination failures. ``enable_debug``
+wires those up in one call; the harness exposes it as ``--debug`` /
+``BENCH_DEBUG=1``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def debug_requested() -> bool:
+    return os.environ.get("BENCH_DEBUG", "0") not in ("0", "", "false")
+
+
+def enable_debug(nans: bool = True, leaks: bool = True, verbose_logging: bool = True) -> None:
+    """Turn on fail-fast numerics and tracer-leak checking.
+
+    - ``jax_debug_nans``: any NaN produced under jit re-runs un-jitted and
+      raises at the producing primitive (the analogue of promoting a silent
+      divergence to an error);
+    - ``jax_check_tracer_leaks``: catches side-channel escapes from traced
+      functions (the closest thing JAX has to a race);
+    - coordination-service faults (a peer host dying) already fail loudly via
+      jax.distributed heartbeat timeouts — no flag needed, parity with
+      NCCL_ASYNC_ERROR_HANDLING comes built in.
+    """
+    import jax
+
+    if nans:
+        jax.config.update("jax_debug_nans", True)
+    if leaks:
+        jax.config.update("jax_check_tracer_leaks", True)
+    if verbose_logging:
+        # jax is already imported by the time this runs, so the env var would
+        # be a no-op — set the live config instead.
+        jax.config.update("jax_traceback_filtering", "off")
+        os.environ.setdefault("TPU_STDERR_LOG_LEVEL", "0")

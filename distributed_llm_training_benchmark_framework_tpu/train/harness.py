@@ -47,6 +47,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--master-port", type=int, default=29500)
     p.add_argument("--num-processes", type=int, default=None,
                    help="Number of host processes (default: env NUM_PROCESSES or 1)")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="Tensor-parallel ('model' mesh axis) width")
+    p.add_argument("--sequence-parallel", type=int, default=1,
+                   help="Sequence-parallel ('seq' mesh axis) width; needs "
+                        "--attention ring")
+    p.add_argument("--pipeline-parallel", type=int, default=1,
+                   help="Pipeline-parallel ('pipe' mesh axis) width; layer "
+                        "count must divide evenly; grad-accum microbatches "
+                        "feed the GPipe schedule")
     # Model & data
     p.add_argument("--tier", type=str, required=True, choices=["A", "B", "S"],
                    help="Model tier (S = tiny CPU/smoke tier, ours)")
@@ -77,6 +86,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--results-dir", type=str, required=True)
     p.add_argument("--profile-dir", type=str, default=None,
                    help="If set, capture a jax.profiler trace after warmup")
+    # Checkpoint / resume (orbax; absent entirely in the reference)
+    p.add_argument("--checkpoint-dir", type=str, default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="Save every N steps (0 = only final)")
+    p.add_argument("--resume", action="store_true",
+                   help="Resume from the latest checkpoint in --checkpoint-dir")
+    p.add_argument("--debug", action="store_true",
+                   help="Fail-fast numerics: NaN checks, tracer-leak checks")
     return p
 
 
@@ -122,6 +139,11 @@ def main(argv=None) -> int:
         else:
             raise ValueError("ZeRO strategy requires --strategy-config")
 
+    from ..runtime.debug import debug_requested, enable_debug
+
+    if args.debug or debug_requested():
+        enable_debug()
+
     strategy = resolve_strategy(args)
     dist.setup_distributed(
         master_addr=args.master_addr,
@@ -142,12 +164,18 @@ def main(argv=None) -> int:
             grad_accum=args.grad_accum,
             world_size=args.world_size,
             rank=args.rank,
+            tensor_parallel=args.tensor_parallel,
+            sequence_parallel=args.sequence_parallel,
+            pipeline_parallel=args.pipeline_parallel,
             results_dir=args.results_dir,
             seed=args.seed,
             attention_impl=args.attention,
             dropout=args.dropout,
             dataset_size=args.dataset_size,
             profile_dir=args.profile_dir,
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
         )
     finally:
         dist.cleanup_distributed()

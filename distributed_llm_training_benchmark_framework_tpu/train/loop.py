@@ -42,6 +42,9 @@ def run_benchmark(
     grad_accum: int,
     world_size: int,
     rank: int = 0,
+    tensor_parallel: int = 1,
+    sequence_parallel: int = 1,
+    pipeline_parallel: int = 1,
     results_dir: Optional[str] = None,
     seed: int = 42,
     attention_impl: str = "reference",
@@ -49,6 +52,9 @@ def run_benchmark(
     dataset_size: int = 1000,
     log_every: int = 10,
     profile_dir: Optional[str] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 0,
+    resume: bool = False,
 ) -> metrics_mod.BenchmarkResult:
     """Run one benchmark arm end-to-end and (on rank 0) emit its result."""
     is_main = dist.is_main_process() and rank == 0
@@ -57,7 +63,34 @@ def run_benchmark(
         raise ValueError(
             f"world_size={world_size} but only {len(devices)} devices visible"
         )
-    mesh = make_mesh((world_size,), ("data",), devices=devices[:world_size])
+    tp, sp, pp = tensor_parallel, sequence_parallel, pipeline_parallel
+    if world_size % (tp * sp * pp) != 0:
+        raise ValueError(
+            f"world_size={world_size} not divisible by "
+            f"tensor*sequence*pipeline parallel={tp * sp * pp}"
+        )
+    dp = world_size // (tp * sp * pp)
+    mesh = make_mesh(
+        (dp, sp, tp, pp),
+        ("data", "seq", "model", "pipe"),
+        devices=devices[:world_size],
+    )
+    if sp > 1 and attention_impl != "ring":
+        raise ValueError("sequence_parallel > 1 requires --attention ring")
+    if pp > 1 and attention_impl == "ring":
+        raise ValueError(
+            "pipeline_parallel does not compose with ring attention yet; "
+            "use dp/tp/pp"
+        )
+    if pp > 1 and tp > 1 and jax.default_backend() == "cpu":
+        # XLA's CPU-only AllReducePromotion pass aborts the process compiling
+        # the partially-manual pipeline with tensor-parallel collectives
+        # inside ("Invalid binary instruction opcode copy"). TPU compiles
+        # this composition; CPU cannot until the upstream bug is fixed.
+        raise ValueError(
+            "pipeline_parallel x tensor_parallel is not supported on the CPU "
+            "backend (XLA CPU compiler bug); run this composition on TPU"
+        )
 
     overrides = {} if dropout is None else {"dropout": dropout}
     model_config = get_model_config(
@@ -89,12 +122,27 @@ def run_benchmark(
     if is_main:
         print(f"SyntheticDataset: {dataset_size} samples, seq_len={seq_len}")
 
-    global_micro = per_device_batch * world_size
+    # Data-parallel width sets the global microbatch; tp/sp groups share
+    # replicas of each example (matching how the reference's world_size
+    # multiplies per-device batch for pure DP, reference train_harness.py:403).
+    global_micro = per_device_batch * dp
     params, opt_state = state.params, state.opt_state
     step_times, losses = [], []
     trace_started = False
 
-    for step in range(steps):
+    ckpt = None
+    start_step = 0
+    if checkpoint_dir:
+        from ..runtime.checkpoint import BenchmarkCheckpointer
+
+        ckpt = BenchmarkCheckpointer(checkpoint_dir, save_every=checkpoint_every)
+        if resume and ckpt.latest_step() is not None:
+            params, opt_state, start_step = ckpt.restore(params, opt_state)
+            start_step += 1
+            if is_main:
+                print(f"Resumed from checkpoint at step {start_step - 1}")
+
+    for step in range(start_step, steps):
         if profile_dir and step == warmup_steps and is_main and not trace_started:
             jax.profiler.start_trace(profile_dir)
             trace_started = True
@@ -113,7 +161,19 @@ def run_benchmark(
             losses.append(float(loss))
         if is_main and step % log_every == 0:
             print(f"[Step {step:04d}] Loss: {float(loss):.4f}, Time: {step_time:.3f}s")
+        # Checkpointing happens outside the timed region (t0..t1 above), so
+        # benchmark step times stay honest.
+        if ckpt is not None and ckpt.should_save(step):
+            ckpt.save(step, params, opt_state)
+            if is_main:
+                print(f"Checkpoint saved at step {step}")
 
+    if ckpt is not None:
+        # Final save only if this run actually executed steps — a resume that
+        # had nothing left to do must not relabel later-step state.
+        if start_step < steps:
+            ckpt.save(steps - 1, params, opt_state, force=True)
+        ckpt.close()
     if trace_started:
         jax.profiler.stop_trace()
 
@@ -134,6 +194,9 @@ def run_benchmark(
         backend=jax.default_backend(),
         n_params=state.n_params,
         attention_impl=attention_impl,
+        tensor_parallel=tp,
+        sequence_parallel=sp,
+        pipeline_parallel=pp,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

@@ -50,10 +50,27 @@ class TrainState:
 
 
 def _resolve_model_config(
-    model_config: tinygpt.TinyGPTConfig, strategy: strat.StrategyConfig
+    model_config: tinygpt.TinyGPTConfig,
+    strategy: strat.StrategyConfig,
+    mesh: Optional[Mesh] = None,
 ) -> tinygpt.TinyGPTConfig:
-    """Fold strategy-level knobs (remat, precision) into the model config."""
+    """Fold strategy-level knobs (remat, precision) into the model config.
+
+    CPU + pipeline special case: XLA's CPU-only AllReducePromotion pass
+    crashes ("Invalid binary instruction opcode copy") on the bf16
+    all-reduces GSPMD emits around the partially-manual pipeline shard_map.
+    TPU reduces bf16 natively and is unaffected; on CPU (tests, smoke) the
+    pipelined arms run fp32 instead.
+    """
+    import jax as _jax
+
     compute_dtype = jnp.bfloat16 if strategy.precision == "bf16" else jnp.float32
+    if (
+        mesh is not None
+        and mesh.shape.get("pipe", 1) > 1
+        and _jax.default_backend() == "cpu"
+    ):
+        compute_dtype = jnp.float32
     return dataclasses.replace(
         model_config, remat=strategy.remat, compute_dtype=compute_dtype
     )
@@ -75,7 +92,7 @@ def make_train_step(
     batch layout: (grad_accum, global_microbatch, seq_len) int32; targets are
     the inputs themselves (parity: reference ``train_harness.py:359``).
     """
-    cfg = _resolve_model_config(model_config, strategy)
+    cfg = _resolve_model_config(model_config, strategy, mesh)
     grad_sharded_specs = strat.param_partition_specs(
         jax.eval_shape(functools.partial(tinygpt.init_params, cfg), jax.random.key(0)),
         mesh,
@@ -95,6 +112,10 @@ def make_train_step(
             deterministic=deterministic_dropout,
         )
 
+    pipelined = mesh.shape.get("pipe", 1) > 1
+    if pipelined:
+        from ..parallel.pipeline import pipeline_loss_fn
+
     def train_step(params, opt_state, batch, step):
         base_key = jax.random.fold_in(jax.random.key(seed), step)
 
@@ -105,7 +126,17 @@ def make_train_step(
             grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
             return (loss_acc + loss, grad_acc), None
 
-        if grad_accum == 1:
+        if pipelined:
+            # The microbatch axis feeds the GPipe schedule directly — the
+            # pipeline IS the gradient accumulation.
+            loss, grads = jax.value_and_grad(
+                lambda p: pipeline_loss_fn(
+                    cfg, mesh, p, batch,
+                    base_key=None if deterministic_dropout else base_key,
+                    deterministic=deterministic_dropout,
+                )
+            )(params)
+        elif grad_accum == 1:
             key = jax.random.fold_in(base_key, 0)
             loss, grads = jax.value_and_grad(micro_loss)(params, batch[0], key)
         else:
@@ -132,7 +163,7 @@ def make_train_step(
         new_params = optax.apply_updates(params, updates)
         return new_params, new_opt_state, loss
 
-    return jax.jit(
+    jitted = jax.jit(
         train_step,
         in_shardings=(
             strat.named(mesh, param_specs),
@@ -147,6 +178,14 @@ def make_train_step(
         ),
         donate_argnums=(0, 1),
     )
+
+    def step_with_mesh(params, opt_state, batch, step):
+        # Trace/execute under the mesh context so mesh-aware ops (ring
+        # attention's shard_map) can discover the axes via get_abstract_mesh.
+        with jax.set_mesh(mesh):
+            return jitted(params, opt_state, batch, step)
+
+    return step_with_mesh
 
 
 def create_train_state(
@@ -163,7 +202,7 @@ def create_train_state(
     across HBM — no single host/device ever holds the full replicated tree
     (the TPU analogue of FSDP's deferred/sharded init).
     """
-    cfg = _resolve_model_config(model_config, strategy)
+    cfg = _resolve_model_config(model_config, strategy, mesh)
     optimizer = strat.make_optimizer(strategy)
 
     params_shape = jax.eval_shape(

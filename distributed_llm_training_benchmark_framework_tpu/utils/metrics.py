@@ -78,6 +78,9 @@ class BenchmarkResult:
     backend: str = ""
     n_params: int = 0
     attention_impl: str = "reference"
+    tensor_parallel: int = 1
+    sequence_parallel: int = 1
+    pipeline_parallel: int = 1
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -105,12 +108,19 @@ def compute_result(
     backend: str = "",
     n_params: int = 0,
     attention_impl: str = "reference",
+    tensor_parallel: int = 1,
+    sequence_parallel: int = 1,
+    pipeline_parallel: int = 1,
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
     # Honest accounting: a step consumes per_device_batch * grad_accum
-    # sequences per device (our accumulation is real; see module docstring).
-    tokens_per_step = per_device_batch * grad_accum * seq_len * world_size
+    # sequences per *data-parallel replica* (our accumulation is real, and
+    # tensor/sequence-parallel groups jointly compute one example rather than
+    # multiplying throughput; see module docstring). With tp=sp=1 this is the
+    # reference's formula (train_harness.py:403).
+    dp = world_size // (tensor_parallel * sequence_parallel * pipeline_parallel)
+    tokens_per_step = per_device_batch * grad_accum * seq_len * dp
     tps = tokens_per_step / mean_step if mean_step > 0 else 0.0
     bytes_per_step = per_device_batch * grad_accum * seq_len * 4
     h2d = (bytes_per_step / mean_step) / 1e9 if mean_step > 0 else 0.0
@@ -135,6 +145,9 @@ def compute_result(
         backend=backend,
         n_params=n_params,
         attention_impl=attention_impl,
+        tensor_parallel=tensor_parallel,
+        sequence_parallel=sequence_parallel,
+        pipeline_parallel=pipeline_parallel,
     )
 
 

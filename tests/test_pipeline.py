@@ -1,0 +1,114 @@
+"""Pipeline-parallel (GPipe) tests on the virtual 8-device mesh."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_llm_training_benchmark_framework_tpu.models import (
+    get_model_config,
+    init_params,
+    loss_fn,
+)
+from distributed_llm_training_benchmark_framework_tpu.parallel import (
+    make_mesh,
+    get_strategy,
+)
+from distributed_llm_training_benchmark_framework_tpu.parallel.pipeline import (
+    pipeline_loss_fn,
+)
+from distributed_llm_training_benchmark_framework_tpu.train import create_train_state
+from distributed_llm_training_benchmark_framework_tpu.data import SyntheticDataset
+
+
+def test_pipeline_loss_matches_plain_forward(eight_devices):
+    """The GPipe schedule computes exactly the plain forward's mean loss."""
+    cfg = get_model_config("S", 64, dropout=0.0)  # 2 layers -> 2 stages
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh((1, 1, 1, 2), ("data", "seq", "model", "pipe"),
+                     devices=jax.devices()[:2])
+    ds = SyntheticDataset(vocab_size=512, seq_len=64, size=16)
+    batch = ds.batch_for_step(0, 4 * 2).reshape(4, 2, 64)  # 4 microbatches
+
+    with jax.set_mesh(mesh):
+        pl_loss = pipeline_loss_fn(cfg, mesh, params, batch)
+    plain = np.mean([float(loss_fn(cfg, params, batch[i], batch[i]))
+                     for i in range(4)])
+    np.testing.assert_allclose(float(pl_loss), plain, rtol=2e-3)
+
+
+def make_state(strategy, mesh_shape, grad_accum):
+    cfg = get_model_config("S", 64, dropout=0.0)
+    n = int(np.prod(mesh_shape))
+    mesh = make_mesh(mesh_shape, ("data", "seq", "model", "pipe"),
+                     devices=jax.devices()[:n])
+    return create_train_state(cfg, get_strategy(strategy), mesh, seed=42,
+                              grad_accum=grad_accum)
+
+
+def run_steps(state, n_steps, dp, grad_accum, seq=64):
+    ds = SyntheticDataset(vocab_size=512, seq_len=seq, size=64)
+    losses = []
+    params, opt = state.params, state.opt_state
+    for step in range(n_steps):
+        batch = ds.batch_for_step(step, dp * 2 * grad_accum).reshape(
+            grad_accum, dp * 2, seq
+        )
+        batch = jax.device_put(batch, state.batch_sharding)
+        params, opt, loss = state.step_fn(params, opt, batch, step)
+        losses.append(float(loss))
+    return losses
+
+
+def test_pp_trajectory_matches_ddp(eight_devices):
+    base = run_steps(make_state("ddp", (2, 1, 1, 1), 4), 3, dp=2, grad_accum=4)
+    pp = run_steps(make_state("ddp", (2, 1, 1, 2), 4), 3, dp=2, grad_accum=4)
+    np.testing.assert_allclose(pp, base, rtol=2e-3)
+
+
+@pytest.mark.skip(
+    reason="XLA's CPU-only AllReducePromotion pass aborts the whole process "
+    "compiling pipeline(manual) x tensor-parallel(auto) collectives; the "
+    "composition compiles on TPU. Guarded in loop.run_benchmark."
+)
+def test_pp_composes_with_tp(eight_devices):
+    base = run_steps(make_state("ddp", (2, 1, 1, 1), 2), 3, dp=2, grad_accum=2)
+    mixed = run_steps(make_state("ddp", (2, 1, 2, 2), 2), 3, dp=2, grad_accum=2)
+    np.testing.assert_allclose(mixed, base, rtol=2e-3)
+
+
+def test_pp_tp_rejected_on_cpu():
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import run_benchmark
+    from distributed_llm_training_benchmark_framework_tpu.parallel import get_strategy
+
+    with pytest.raises(ValueError, match="CPU"):
+        run_benchmark(
+            strategy=get_strategy("ddp"), tier="S", seq_len=64, steps=1,
+            warmup_steps=0, per_device_batch=1, grad_accum=2, world_size=8,
+            tensor_parallel=2, pipeline_parallel=2,
+        )
+
+
+def test_pp_param_placement(eight_devices):
+    state = make_state("ddp", (1, 1, 1, 2), 2)
+    spec = tuple(state.param_specs["blocks"]["wqkv"])
+    assert spec[0] == "pipe"
+    w = state.params["blocks"]["wqkv"]
+    # Each stage holds half the layer stack.
+    assert w.sharding.shard_shape(w.shape)[0] == w.shape[0] // 2
+
+
+def test_pp_rejects_indivisible_layers():
+    from distributed_llm_training_benchmark_framework_tpu.parallel.pipeline import (
+        pipeline_loss_fn,
+    )
+
+    cfg = get_model_config("S", 64, dropout=0.0)  # 2 layers
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh((1, 1, 1, 2), ("data", "seq", "model", "pipe"),
+                     devices=jax.devices()[:2])
+    import dataclasses
+
+    bad_cfg = dataclasses.replace(cfg, n_layer=3)
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline_loss_fn(bad_cfg, mesh, params, np.zeros((2, 1, 64), np.int32))

@@ -1,0 +1,110 @@
+"""Tensor-parallel and sequence-parallel composition tests (8-dev CPU mesh).
+
+Neither exists in the reference (SURVEY §2.3: TP/PP/SP all listed as future
+work there); here they are first-class mesh axes that compose with the four
+ZeRO-style arms. Correctness bar: the same seed/data must produce the same
+loss trajectory whatever the mesh factorization — parallelism changes where
+arrays live, never what is computed.
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_llm_training_benchmark_framework_tpu.models import get_model_config
+from distributed_llm_training_benchmark_framework_tpu.parallel import (
+    make_mesh,
+    get_strategy,
+    param_partition_specs,
+)
+from distributed_llm_training_benchmark_framework_tpu.train import create_train_state
+from distributed_llm_training_benchmark_framework_tpu.data import SyntheticDataset
+
+
+def make_state(strategy, mesh_shape, attention="reference", grad_accum=1):
+    cfg = get_model_config("S", 64, dropout=0.0, attention_impl=attention)
+    mesh = make_mesh(mesh_shape, ("data", "seq", "model"), devices=jax.devices()[: int(np.prod(mesh_shape))])
+    return create_train_state(cfg, get_strategy(strategy), mesh, seed=42, grad_accum=grad_accum)
+
+
+def run_steps(state, n_steps, dp, grad_accum=1, seq=64):
+    ds = SyntheticDataset(vocab_size=512, seq_len=seq, size=64)
+    losses = []
+    params, opt = state.params, state.opt_state
+    for step in range(n_steps):
+        batch = ds.batch_for_step(step, dp * 2 * grad_accum).reshape(grad_accum, dp * 2, seq)
+        batch = jax.device_put(batch, state.batch_sharding)
+        params, opt, loss = state.step_fn(params, opt, batch, step)
+        losses.append(float(loss))
+    return losses
+
+
+def test_tp_param_layout(eight_devices):
+    """Megatron layout: qkv column-parallel, wo row-parallel, vocab sharded."""
+    state = make_state("ddp", (1, 1, 8))
+    specs = state.param_specs
+    assert tuple(specs["blocks"]["wqkv"]) == (None, None, None, "model")
+    assert tuple(specs["blocks"]["wo"]) == (None, "model", None)
+    assert tuple(specs["blocks"]["wfc"]) == (None, None, "model")
+    assert tuple(specs["blocks"]["wproj"]) == (None, "model", None)
+    assert tuple(specs["wte"]) == ("model", None)
+    # LayerNorms replicated
+    assert tuple(specs["blocks"]["ln1_scale"]) == (None, None)
+    # Shards are real: each device holds 1/8 of wqkv.
+    w = state.params["blocks"]["wqkv"]
+    assert np.prod(w.sharding.shard_shape(w.shape)) == np.prod(w.shape) // 8
+
+
+def test_tp_matches_ddp_trajectory(eight_devices):
+    base = run_steps(make_state("ddp", (4, 1, 1)), 3, dp=4)
+    tp = run_steps(make_state("ddp", (4, 1, 2)), 3, dp=4)
+    np.testing.assert_allclose(tp, base, rtol=2e-3)
+
+
+def test_fsdp_composes_with_tp(eight_devices):
+    """2-D mesh: 'data' sharding lands on a different axis than 'model'."""
+    state = make_state("fsdp", (4, 1, 2))
+    specs = state.param_specs
+    wfc = tuple(specs["blocks"]["wfc"])
+    assert "model" in wfc and "data" in wfc and wfc.index("model") != wfc.index("data")
+    base = run_steps(make_state("ddp", (4, 1, 1)), 3, dp=4)
+    mixed = run_steps(state, 3, dp=4)
+    np.testing.assert_allclose(mixed, base, rtol=2e-3)
+
+
+def test_sp_ring_matches_ddp_trajectory(eight_devices):
+    base = run_steps(make_state("ddp", (2, 1, 1)), 3, dp=2)
+    sp = run_steps(make_state("ddp", (2, 4, 1), attention="ring"), 3, dp=2)
+    np.testing.assert_allclose(sp, base, rtol=5e-3)
+
+
+def test_dp_sp_tp_all_at_once(eight_devices):
+    """The full 3-D mesh: 2-way data x 2-way sequence x 2-way tensor."""
+    base = run_steps(make_state("zero2", (2, 1, 1)), 3, dp=2)
+    full = run_steps(make_state("zero2", (2, 2, 2), attention="ring"), 3, dp=2)
+    np.testing.assert_allclose(full, base, rtol=5e-3)
+
+
+def test_world_size_not_divisible_raises():
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import run_benchmark
+    from distributed_llm_training_benchmark_framework_tpu.parallel import get_strategy
+
+    with pytest.raises(ValueError, match="not divisible"):
+        run_benchmark(
+            strategy=get_strategy("ddp"), tier="S", seq_len=64, steps=1,
+            warmup_steps=0, per_device_batch=1, grad_accum=1, world_size=6,
+            tensor_parallel=4,
+        )
+
+
+def test_sp_requires_ring():
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import run_benchmark
+    from distributed_llm_training_benchmark_framework_tpu.parallel import get_strategy
+
+    with pytest.raises(ValueError, match="ring"):
+        run_benchmark(
+            strategy=get_strategy("ddp"), tier="S", seq_len=64, steps=1,
+            warmup_steps=0, per_device_batch=1, grad_accum=1, world_size=8,
+            sequence_parallel=2,
+        )
