@@ -1,0 +1,110 @@
+"""Mixture-of-Experts MLP with expert parallelism — GShard-style dispatch.
+
+The reference lists "Mistral/Mixtral architectures" and MoE only as future
+work (reference ``README.md:1025``); here sparse expert layers are a
+first-class model family with their own mesh axis.
+
+TPU-native formulation (GShard/Switch): routing is expressed as two dense
+einsums against a one-hot *dispatch* tensor instead of gather/scatter —
+static shapes, MXU-friendly, and when the expert axis of the
+``(experts, capacity, d_model)`` buffers is sharded over the 'expert' mesh
+axis, GSPMD lowers the dispatch/combine einsums into the all-to-all exchange
+expert parallelism needs.
+
+Top-k routing with capacity: each token picks its top-k experts by router
+probability; each expert accepts at most C = ceil(capacity_factor * k * N / E)
+tokens (token order breaks ties); overflowing tokens are dropped for that
+expert (their combine weight is zero) — the standard capacity discipline that
+keeps every shape static under jit.
+
+The load-balance auxiliary loss is Switch-style: E * sum_e f_e * P_e, where
+f_e is the fraction of tokens dispatched to expert e (top-1 assignment) and
+P_e the mean router probability — minimized at uniform routing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def capacity(n_tokens: int, n_experts: int, top_k: int, factor: float) -> int:
+    c = int(factor * top_k * n_tokens / n_experts + 0.999)
+    return max(c, top_k)
+
+
+def moe_mlp(
+    config,
+    layer: dict,  # one layer's params: router, moe_w1/b1, moe_w2/b2
+    x: jax.Array,  # (B, S, D) compute dtype
+    dropout_key: Optional[jax.Array],
+    deterministic: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """-> (output (B,S,D), aux load-balance loss scalar fp32)."""
+    from .tinygpt import _dropout  # shared dropout primitive
+
+    c = config
+    B, S, D = x.shape
+    N = B * S
+    E, K = c.n_experts, c.expert_top_k
+    C = capacity(N, E, K, c.capacity_factor)
+    xt = x.reshape(N, D)
+
+    # Router in fp32 (numerics discipline as for softmax/LN elsewhere).
+    logits = jnp.einsum(
+        "nd,de->ne", xt, layer["router"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
+
+    gate_vals, expert_idx = jax.lax.top_k(probs, K)  # (N, K)
+    # Renormalize the chosen gates so they sum to 1 per token.
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9
+    )
+
+    # Position of each (token, choice) in its expert's capacity buffer:
+    # count prior assignments to the same expert in (token-major, choice-major)
+    # order via a cumulative sum over one-hots.
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # (N, K, E)
+    flat = onehot.reshape(N * K, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat  # prior count per expert
+    pos = jnp.sum(pos_flat.reshape(N, K, E) * onehot, axis=-1)  # (N, K)
+    keep = pos < C  # overflowing assignments are dropped
+
+    # dispatch (N, E, C): 1 where token n occupies slot c of expert e.
+    disp = (
+        jax.nn.one_hot(expert_idx, E, dtype=x.dtype)[:, :, :, None]
+        * jax.nn.one_hot(jnp.where(keep, pos, C), C + 1, dtype=x.dtype)[:, :, None, :C]
+    )  # (N, K, E, C); pos>=C one-hots into the dropped C+1th slot, sliced off
+    dispatch = jnp.sum(disp, axis=1)  # (N, E, C)
+    combine = jnp.sum(disp * gate_vals[:, :, None, None].astype(x.dtype), axis=1)
+
+    # Expert compute on (E, C, D) buffers — batched over the expert axis,
+    # shardable on the 'expert' mesh axis.
+    xin = jnp.einsum("nd,nec->ecd", xt, dispatch, preferred_element_type=jnp.float32)
+    xin = xin.astype(c.compute_dtype)
+    h = jnp.einsum(
+        "ecd,edf->ecf", xin, layer["moe_w1"].astype(c.compute_dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(c.compute_dtype) + layer["moe_b1"].astype(c.compute_dtype)[:, None, :]
+    h = jax.nn.gelu(h, approximate=False)
+    out_e = jnp.einsum(
+        "ecf,efd->ecd", h, layer["moe_w2"].astype(c.compute_dtype),
+        preferred_element_type=jnp.float32,
+    ).astype(c.compute_dtype) + layer["moe_b2"].astype(c.compute_dtype)[:, None, :]
+
+    y = jnp.einsum(
+        "ecd,nec->nd", out_e, combine, preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    y = _dropout(y, c.dropout, dropout_key, deterministic)
+
+    # Switch load-balance loss on the top-1 assignment.
+    top1 = jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32)
+    f = jnp.mean(top1, axis=0)           # fraction of tokens per expert
+    p = jnp.mean(probs, axis=0)          # mean router prob per expert
+    aux = E * jnp.sum(f * p)
+
+    return y.reshape(B, S, D), aux

@@ -61,6 +61,13 @@ class TinyGPTConfig:
     compute_dtype: Any = jnp.bfloat16
     # Per-layer rematerialization (activation checkpointing) inside the scan.
     remat: bool = False
+    # Mixture-of-Experts MLP (0 = dense). When > 0 every block's MLP becomes
+    # a top-k routed expert layer (models.moe) and the training loss gains
+    # the Switch load-balance auxiliary term.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -111,6 +118,12 @@ PARAM_AXIS_RULES: Dict[str, Tuple[Optional[str], ...]] = {
     "blocks/bfc": ("layers", "mlp"),
     "blocks/wproj": ("layers", "mlp", "embed"),
     "blocks/bproj": ("layers", "embed"),
+    # MoE variant (present instead of wfc/bfc/wproj/bproj when n_experts > 0)
+    "blocks/router": ("layers", "embed", "experts"),
+    "blocks/moe_w1": ("layers", "experts", "embed", "mlp"),
+    "blocks/moe_b1": ("layers", "experts", "mlp"),
+    "blocks/moe_w2": ("layers", "experts", "mlp", "embed"),
+    "blocks/moe_b2": ("layers", "experts", "embed"),
     "lnf_scale": ("embed",),
     "lnf_bias": ("embed",),
 }
@@ -134,23 +147,36 @@ def init_params(config: TinyGPTConfig, key: jax.Array) -> Params:
     zeros = lambda shape: jnp.zeros(shape, c.param_dtype)
     ones = lambda shape: jnp.ones(shape, c.param_dtype)
 
+    blocks = {
+        "ln1_scale": ones((L, D)),
+        "ln1_bias": zeros((L, D)),
+        "wqkv": normal(next(k), (L, D, 3, D)),
+        "bqkv": zeros((L, 3, D)),
+        "wo": normal(next(k), (L, D, D)),
+        "bo": zeros((L, D)),
+        "ln2_scale": ones((L, D)),
+        "ln2_bias": zeros((L, D)),
+    }
+    if c.n_experts > 0:
+        E = c.n_experts
+        blocks.update(
+            router=normal(next(k), (L, D, E)),
+            moe_w1=normal(next(k), (L, E, D, 4 * D)),
+            moe_b1=zeros((L, E, 4 * D)),
+            moe_w2=normal(next(k), (L, E, 4 * D, D)),
+            moe_b2=zeros((L, E, D)),
+        )
+    else:
+        blocks.update(
+            wfc=normal(next(k), (L, D, 4 * D)),
+            bfc=zeros((L, 4 * D)),
+            wproj=normal(next(k), (L, 4 * D, D)),
+            bproj=zeros((L, D)),
+        )
     return {
         "wte": normal(next(k), (V, D)),
         "wpe": normal(next(k), (T, D)),
-        "blocks": {
-            "ln1_scale": ones((L, D)),
-            "ln1_bias": zeros((L, D)),
-            "wqkv": normal(next(k), (L, D, 3, D)),
-            "bqkv": zeros((L, 3, D)),
-            "wo": normal(next(k), (L, D, D)),
-            "bo": zeros((L, D)),
-            "ln2_scale": ones((L, D)),
-            "ln2_bias": zeros((L, D)),
-            "wfc": normal(next(k), (L, D, 4 * D)),
-            "bfc": zeros((L, 4 * D)),
-            "wproj": normal(next(k), (L, 4 * D, D)),
-            "bproj": zeros((L, D)),
-        },
+        "blocks": blocks,
         "lnf_scale": ones((D,)),
         "lnf_bias": zeros((D,)),
     }
@@ -228,8 +254,11 @@ def _block(
     layer: Params,  # one layer's slice of the stacked block params
     dropout_key: Optional[jax.Array],
     deterministic: bool,
-) -> jax.Array:
-    """Pre-LN transformer block (parity: reference train_harness.py:108-131)."""
+) -> Tuple[jax.Array, jax.Array]:
+    """Pre-LN transformer block -> (x, aux) where aux is the MoE load-balance
+    loss contribution (0 for dense blocks).
+
+    Parity: reference train_harness.py:108-131 for the dense path."""
     c = config
     B, S, D = x.shape
     cd = c.compute_dtype
@@ -255,8 +284,14 @@ def _block(
     )
     x = x + attn
 
-    # --- MLP sublayer: D -> 4D -> GELU(exact) -> D -> dropout ---
+    # --- MLP sublayer: dense D -> 4D -> GELU(exact) -> D -> dropout,
+    #     or the routed expert layer when n_experts > 0 ---
     h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    if c.n_experts > 0:
+        from .moe import moe_mlp
+
+        h, aux = moe_mlp(c, layer, h, keys[1], deterministic)
+        return x + h, aux
     h = (
         jnp.einsum("bsd,df->bsf", h, layer["wfc"].astype(cd), preferred_element_type=jnp.float32)
         .astype(cd)
@@ -269,7 +304,7 @@ def _block(
         + layer["bproj"].astype(cd)
     )
     h = _dropout(h, c.dropout, keys[1], deterministic)
-    return x + h
+    return x + h, jnp.zeros((), jnp.float32)
 
 
 def embed(
@@ -303,6 +338,9 @@ def apply_blocks(
     ``layer_offset`` keeps per-layer dropout keys globally consistent when the
     stack is a pipeline stage's slice: layer i's key is fold_in(base_key,
     layer_offset + i) regardless of which stage runs it.
+
+    Returns (x, aux_sum): aux_sum accumulates MoE load-balance contributions
+    over the scanned layers (0 for dense models).
     """
     c = config
     block = functools.partial(_block, c, deterministic=deterministic)
@@ -310,17 +348,25 @@ def apply_blocks(
         block = jax.checkpoint(block)
 
     if base_key is None or deterministic:
-        scan_body = lambda carry, layer: (block(carry, layer, None), None)
-        x, _ = lax.scan(scan_body, x, blocks)
+        def scan_body(carry, layer):
+            x, aux = carry
+            x, a = block(x, layer, None)
+            return (x, aux + a), None
+
+        (x, aux), _ = lax.scan(scan_body, (x, jnp.zeros((), jnp.float32)), blocks)
     else:
         n_local = jax.tree_util.tree_leaves(blocks)[0].shape[0]
         idxs = jnp.arange(n_local) + layer_offset
-        scan_body = lambda carry, li: (
-            block(carry, li[0], jax.random.fold_in(base_key, li[1])),
-            None,
+
+        def scan_body(carry, li):
+            x, aux = carry
+            x, a = block(x, li[0], jax.random.fold_in(base_key, li[1]))
+            return (x, aux + a), None
+
+        (x, aux), _ = lax.scan(
+            scan_body, (x, jnp.zeros((), jnp.float32)), (blocks, idxs)
         )
-        x, _ = lax.scan(scan_body, x, (blocks, idxs))
-    return x
+    return x, aux
 
 
 def head(config: TinyGPTConfig, params: Params, x: jax.Array) -> jax.Array:
@@ -363,12 +409,15 @@ def forward(
         emb_key = scan_key = None
 
     x = embed(c, params, idx, emb_key, deterministic)
-    x = apply_blocks(c, params["blocks"], x, scan_key, deterministic)
+    x, aux = apply_blocks(c, params["blocks"], x, scan_key, deterministic)
     logits = head(c, params, x)
 
     loss = None
     if targets is not None:
         loss = _cross_entropy(logits, targets)
+        if c.n_experts > 0:
+            # Mean aux per layer, Switch-style coefficient.
+            loss = loss + c.router_aux_coef * aux / c.n_layer
     return logits, loss
 
 

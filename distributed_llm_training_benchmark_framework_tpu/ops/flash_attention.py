@@ -67,12 +67,14 @@ def _flash_fwd_kernel(
 
     @pl.when(live)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)  # (bq, d)
-        k = k_ref[0].astype(jnp.float32)  # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
+        # bf16 operands on the MXU, fp32 accumulation via
+        # preferred_element_type — softmax statistics stay fp32 throughout.
+        q = q_ref[0]  # (bq, d) input dtype
+        k = k_ref[0]  # (bk, d)
+        v = v_ref[0]
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (bq, bk)
+        ) * scale  # (bq, bk) fp32
 
         if causal:
             rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
@@ -84,14 +86,15 @@ def _flash_fwd_kernel(
         m_cur = jnp.max(s, axis=-1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)             # (bq, 1)
-        p = jnp.exp(s - m_new)                      # (bq, bk)
+        p = jnp.exp(s - m_new)                      # (bq, bk) fp32
         if causal:
             p = jnp.where(mask, p, 0.0)
 
         l_prev = l_scr[:, :1]
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -148,73 +151,240 @@ def _flash_forward(
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _flash(opts: Tuple, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    causal, interpret, bq, bk = opts
+    causal, interpret, bq, bk, _ = opts
     out, _ = _flash_forward(q, k, v, causal, interpret, bq, bk)
     return out
 
 
 def _flash_fwd_rule(opts, q, k, v):
-    causal, interpret, bq, bk = opts
+    causal, interpret, bq, bk, _ = opts
     out, lse = _flash_forward(q, k, v, causal, interpret, bq, bk)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(opts, res, do):
-    """Blockwise flash backward from the saved logsumexp.
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc,
+    *, bq: int, bk: int, scale: float, causal: bool,
+):
+    """dq = sum over k blocks of ds @ k, ds = p * (dp - delta) * scale."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    Standard identities (per batch*head row block):
-        p    = exp(q k^T * scale - lse)
-        dv   = p^T do
-        dp   = do v^T
-        ds   = p * (dp - delta) * scale,  delta = rowsum(do * o)
-        dq   = ds k ;  dk = ds^T q
-    computed as a scan over K blocks so only (S, bk) tiles materialize.
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    live = (not causal) or (ki * bk < (qi + 1) * bq)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][0]      # (bq,)
+        delta = delta_ref[0][0]  # (bq,)
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = rows >= cols
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        acc[:] = acc[:] + lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, bq: int, bk: int, scale: float, causal: bool,
+):
+    """dk = sum over q blocks of ds^T @ q; dv = sum of p^T @ do."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (not causal) or (ki * bk < (qi + 1) * bq)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][0]
+        delta = delta_ref[0][0]
+        s = lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            mask = rows >= cols
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        pc = p.astype(q.dtype)
+        dv_acc[:] = dv_acc[:] + lax.dot_general(
+            pc, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        dk_acc[:] = dk_acc[:] + lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _jnp_blockwise_bwd(causal, bk, res, do):
+    """Blockwise flash backward as batched einsums over a K-block scan.
+
+    Same math as the Pallas kernels below, expressed as XLA-fused dense
+    einsums: only (S, bk) tiles materialize. Measured FASTER than the Pallas
+    backward on v5e (XLA schedules the batched-over-heads contractions onto
+    the MXU better than the per-(head, tile) kernel grid) — hence the default.
     """
-    causal, _, _, bk = opts
     q, k, v, out, lse = res
     BH, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
     f32 = jnp.float32
-    qf, kf, vf, dof = (t.astype(f32) for t in (q, k, v, do))
-    delta = jnp.sum(dof * out.astype(f32), axis=-1)  # (BH, S)
+    cd = q.dtype  # matmul operand dtype (bf16 on TPU); accumulation is fp32
+    dof = do.astype(cd)
+    delta = jnp.sum(
+        do.astype(f32) * out.astype(f32), axis=-1
+    )  # (BH, S) fp32
 
     nk = S // bk
-    ks = kf.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)  # (nk, BH, bk, D)
-    vs = vf.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)
-
+    ks = k.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)  # (nk, BH, bk, D)
+    vs = v.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)
     rows = jnp.arange(S)
 
     def one_block(dq_acc, blk):
         ki, k_b, v_b = blk
-        s = jnp.einsum("bqd,bkd->bqk", qf, k_b, preferred_element_type=f32) * scale
+        s = jnp.einsum("bqd,bkd->bqk", q, k_b, preferred_element_type=f32) * scale
         if causal:
             cols = ki * bk + jnp.arange(bk)
             mask = rows[:, None] >= cols[None, :]
             s = jnp.where(mask[None], s, NEG_INF)
-        p = jnp.exp(s - lse[:, :, None])  # (BH, S, bk)
+        p = jnp.exp(s - lse[:, :, None])  # (BH, S, bk) fp32
         if causal:
             p = jnp.where(mask[None], p, 0.0)
-        dv_b = jnp.einsum("bqk,bqd->bkd", p, dof, preferred_element_type=f32)
+        pc = p.astype(cd)
+        dv_b = jnp.einsum("bqk,bqd->bkd", pc, dof, preferred_element_type=f32)
         dp = jnp.einsum("bqd,bkd->bqk", dof, v_b, preferred_element_type=f32)
-        ds = p * (dp - delta[:, :, None]) * scale
+        ds = (p * (dp - delta[:, :, None]) * scale).astype(cd)
         dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_b, preferred_element_type=f32)
-        dk_b = jnp.einsum("bqk,bqd->bkd", ds, qf, preferred_element_type=f32)
+        dk_b = jnp.einsum("bqk,bqd->bkd", ds, q, preferred_element_type=f32)
         return dq_acc, (dk_b, dv_b)
 
     dq0 = jnp.zeros((BH, S, D), f32)
-    dq, (dk_blocks, dv_blocks) = lax.scan(
-        one_block, dq0, (jnp.arange(nk), ks, vs)
-    )
+    dq, (dk_blocks, dv_blocks) = lax.scan(one_block, dq0, (jnp.arange(nk), ks, vs))
     dk = dk_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
     dv = dv_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _flash_bwd_rule(opts, res, do):
+    """Flash backward: recompute attention probabilities per tile from the
+    saved logsumexp. Two implementations, selected by ``pallas_backward``:
+    the default XLA-fused blockwise einsum path (faster on v5e), and the
+    hand-written Pallas kernel pair (dq; dk/dv) below.
+    """
+    causal, interpret, bq, bk, pallas_bwd = opts
+    if not pallas_bwd:
+        return _jnp_blockwise_bwd(causal, bk, res, do)
+    q, k, v, out, lse = res
+    BH, S, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (BH, S)
+    # lse/delta enter the kernels sublane-broadcast as (BH, 8, S) to satisfy
+    # the (8, 128) input-tile constraint (same trick as the forward's output).
+    lse3 = jnp.broadcast_to(lse[:, None, :], (BH, 8, S))
+    delta3 = jnp.broadcast_to(delta[:, None, :], (BH, 8, S))
+
+    row_specs = dict(
+        q=pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
+        k=pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+        stat=pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, 0, qi)),
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, scale=scale, causal=causal),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        grid=(BH, S // bq, S // bk),
+        in_specs=[row_specs["q"], row_specs["k"], row_specs["k"],
+                  row_specs["q"], row_specs["stat"], row_specs["stat"]],
+        out_specs=row_specs["q"],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+
+    col_specs = dict(
+        q=pl.BlockSpec((1, bq, D), lambda b, ki, qi: (b, qi, 0)),
+        k=pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
+        stat=pl.BlockSpec((1, 8, bq), lambda b, ki, qi: (b, 0, qi)),
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, scale=scale, causal=causal),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+        ],
+        grid=(BH, S // bk, S // bq),
+        in_specs=[col_specs["q"], col_specs["k"], col_specs["k"],
+                  col_specs["q"], col_specs["stat"], col_specs["stat"]],
+        out_specs=[col_specs["k"], col_specs["k"]],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse3, delta3)
+
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "interpret", "block_q", "block_k")
+    jax.jit,
+    static_argnames=("causal", "interpret", "block_q", "block_k", "pallas_backward"),
 )
 def flash_attention(
     q: jax.Array,  # (B, S, H, D)
@@ -224,6 +394,7 @@ def flash_attention(
     interpret: Optional[bool] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    pallas_backward: bool = False,
 ) -> jax.Array:
     """Multi-head flash attention over (batch, seq, heads, head_dim) inputs."""
     B, S, H, D = q.shape
@@ -240,7 +411,10 @@ def flash_attention(
     def to_bhsd(t):
         return t.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
-    out = _flash((causal, interpret, bq, bk), to_bhsd(q), to_bhsd(k), to_bhsd(v))
+    out = _flash(
+        (causal, interpret, bq, bk, pallas_backward),
+        to_bhsd(q), to_bhsd(k), to_bhsd(v),
+    )
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 

@@ -67,6 +67,11 @@ def pipeline_loss_fn(
         raise ValueError(
             f"n_layer={config.n_layer} not divisible by pipe={n_stages}"
         )
+    if config.n_experts > 0:
+        raise ValueError(
+            "MoE does not compose with pipeline parallelism in this version "
+            "(per-stage aux-loss accounting); use dp/tp/ep"
+        )
     layers_per_stage = config.n_layer // n_stages
     n_micro = batch.shape[0]
     ticks = n_micro + n_stages - 1
@@ -103,7 +108,7 @@ def pipeline_loss_fn(
                 if base_key is not None and not deterministic
                 else None
             )
-            state_out = tinygpt.apply_blocks(
+            state_out, _ = tinygpt.apply_blocks(
                 config, blocks, state_in, bk, deterministic, layer_offset=offset
             )
 

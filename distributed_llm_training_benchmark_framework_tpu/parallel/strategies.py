@@ -192,6 +192,21 @@ _TP_RULES = {
     "blocks/wfc": (2,),  # column-parallel output
     "blocks/bfc": (1,),
     "blocks/wproj": (1,),  # row-parallel input
+    # MoE experts: column-parallel w1, row-parallel w2 inside each expert
+    "blocks/moe_w1": (3,),
+    "blocks/moe_b1": (2,),
+    "blocks/moe_w2": (2,),
+}
+
+# Expert parallelism over the 'expert' mesh axis: each device group owns a
+# slice of the expert set; the dispatch/combine einsums in models.moe become
+# the all-to-all. The router stays replicated (it is tiny and every token
+# needs all scores).
+_EP_RULES = {
+    "blocks/moe_w1": 1,
+    "blocks/moe_b1": 1,
+    "blocks/moe_w2": 1,
+    "blocks/moe_b2": 1,
 }
 
 
@@ -231,6 +246,7 @@ def param_partition_specs(params: Params, mesh: Mesh, shard: bool) -> Params:
     n_data = mesh.shape.get("data", 1)
     n_model = mesh.shape.get("model", 1)
     n_pipe = mesh.shape.get("pipe", 1)
+    n_expert = mesh.shape.get("expert", 1)
 
     def spec(path, leaf):
         s = [None] * len(leaf.shape)
@@ -239,9 +255,13 @@ def param_partition_specs(params: Params, mesh: Mesh, shard: bool) -> Params:
         if n_pipe > 1 and is_block:
             # Pipeline stages own contiguous slices of the stacked layers axis.
             s[0] = "pipe"
+        if n_expert > 1 and name in _EP_RULES:
+            ax = _EP_RULES[name]
+            if leaf.shape[ax] % n_expert == 0:
+                s[ax] = "expert"
         if n_model > 1:
             for ax in _TP_RULES.get(name, ()):
-                if leaf.shape[ax] % n_model == 0:
+                if s[ax] is None and leaf.shape[ax] % n_model == 0:
                     s[ax] = "model"
         if shard and n_data > 1:
             _shard_largest_free_axis(s, leaf.shape, n_data, is_block)

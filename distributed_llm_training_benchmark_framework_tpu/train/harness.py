@@ -56,6 +56,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Pipeline-parallel ('pipe' mesh axis) width; layer "
                         "count must divide evenly; grad-accum microbatches "
                         "feed the GPipe schedule")
+    p.add_argument("--expert-parallel", type=int, default=1,
+                   help="Expert-parallel ('expert' mesh axis) width; needs "
+                        "--num-experts divisible by it")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help="Mixture-of-Experts MLP with this many experts "
+                        "(0 = dense TinyGPT)")
     # Model & data
     p.add_argument("--tier", type=str, required=True, choices=["A", "B", "S"],
                    help="Model tier (S = tiny CPU/smoke tier, ours)")
@@ -167,6 +173,8 @@ def main(argv=None) -> int:
             tensor_parallel=args.tensor_parallel,
             sequence_parallel=args.sequence_parallel,
             pipeline_parallel=args.pipeline_parallel,
+            expert_parallel=args.expert_parallel,
+            n_experts=args.num_experts,
             results_dir=args.results_dir,
             seed=args.seed,
             attention_impl=args.attention,

@@ -45,6 +45,8 @@ def run_benchmark(
     tensor_parallel: int = 1,
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
+    expert_parallel: int = 1,
+    n_experts: int = 0,
     results_dir: Optional[str] = None,
     seed: int = 42,
     attention_impl: str = "reference",
@@ -63,16 +65,22 @@ def run_benchmark(
         raise ValueError(
             f"world_size={world_size} but only {len(devices)} devices visible"
         )
-    tp, sp, pp = tensor_parallel, sequence_parallel, pipeline_parallel
-    if world_size % (tp * sp * pp) != 0:
+    tp, sp, pp, ep = (
+        tensor_parallel, sequence_parallel, pipeline_parallel, expert_parallel
+    )
+    if ep > 1 and n_experts == 0:
+        raise ValueError("expert_parallel > 1 requires --num-experts > 0")
+    if n_experts > 0 and ep > 1 and n_experts % ep != 0:
+        raise ValueError(f"n_experts={n_experts} not divisible by expert_parallel={ep}")
+    if world_size % (tp * sp * pp * ep) != 0:
         raise ValueError(
             f"world_size={world_size} not divisible by "
-            f"tensor*sequence*pipeline parallel={tp * sp * pp}"
+            f"tensor*sequence*pipeline*expert parallel={tp * sp * pp * ep}"
         )
-    dp = world_size // (tp * sp * pp)
+    dp = world_size // (tp * sp * pp * ep)
     mesh = make_mesh(
-        (dp, sp, tp, pp),
-        ("data", "seq", "model", "pipe"),
+        (dp, sp, tp, pp, ep),
+        ("data", "seq", "model", "pipe", "expert"),
         devices=devices[:world_size],
     )
     if sp > 1 and attention_impl != "ring":
@@ -93,9 +101,13 @@ def run_benchmark(
         )
 
     overrides = {} if dropout is None else {"dropout": dropout}
+    if n_experts > 0:
+        overrides["n_experts"] = n_experts
     model_config = get_model_config(
         tier, seq_len, attention_impl=attention_impl, **overrides
     )
+    if n_experts > 0 and pp > 1:
+        raise ValueError("MoE does not compose with pipeline parallelism yet")
     if is_main:
         print(f"Strategy: {strategy.describe()}")
         if attention_impl != "reference" and model_config.dropout > 0:
@@ -197,6 +209,8 @@ def run_benchmark(
         tensor_parallel=tp,
         sequence_parallel=sp,
         pipeline_parallel=pp,
+        expert_parallel=ep,
+        n_experts=n_experts,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

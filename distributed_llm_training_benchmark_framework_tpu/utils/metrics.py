@@ -81,6 +81,8 @@ class BenchmarkResult:
     tensor_parallel: int = 1
     sequence_parallel: int = 1
     pipeline_parallel: int = 1
+    expert_parallel: int = 1
+    n_experts: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -111,6 +113,8 @@ def compute_result(
     tensor_parallel: int = 1,
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
+    expert_parallel: int = 1,
+    n_experts: int = 0,
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
@@ -119,7 +123,9 @@ def compute_result(
     # tensor/sequence-parallel groups jointly compute one example rather than
     # multiplying throughput; see module docstring). With tp=sp=1 this is the
     # reference's formula (train_harness.py:403).
-    dp = world_size // (tensor_parallel * sequence_parallel * pipeline_parallel)
+    dp = world_size // (
+        tensor_parallel * sequence_parallel * pipeline_parallel * expert_parallel
+    )
     tokens_per_step = per_device_batch * grad_accum * seq_len * dp
     tps = tokens_per_step / mean_step if mean_step > 0 else 0.0
     bytes_per_step = per_device_batch * grad_accum * seq_len * 4
@@ -148,6 +154,8 @@ def compute_result(
         tensor_parallel=tensor_parallel,
         sequence_parallel=sequence_parallel,
         pipeline_parallel=pipeline_parallel,
+        expert_parallel=expert_parallel,
+        n_experts=n_experts,
     )
 
 

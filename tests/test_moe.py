@@ -1,0 +1,134 @@
+"""Mixture-of-Experts + expert-parallelism tests (virtual 8-device mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_training_benchmark_framework_tpu.models import (
+    get_model_config,
+    init_params,
+    forward,
+    loss_fn,
+)
+from distributed_llm_training_benchmark_framework_tpu.models.moe import capacity
+from distributed_llm_training_benchmark_framework_tpu.parallel import (
+    make_mesh,
+    get_strategy,
+)
+from distributed_llm_training_benchmark_framework_tpu.train import create_train_state
+from distributed_llm_training_benchmark_framework_tpu.data import SyntheticDataset
+
+
+def moe_cfg(**kw):
+    kw.setdefault("dropout", 0.0)
+    kw.setdefault("n_experts", 4)
+    return get_model_config("S", 64, **kw)
+
+
+def test_capacity_formula():
+    assert capacity(n_tokens=128, n_experts=4, top_k=2, factor=1.0) == 64
+    assert capacity(n_tokens=10, n_experts=8, top_k=2, factor=1.0) >= 2
+
+
+def test_moe_param_tree_shape():
+    cfg = moe_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    b = params["blocks"]
+    assert "wfc" not in b and "router" in b
+    L, D, E = cfg.n_layer, cfg.n_embd, cfg.n_experts
+    assert b["router"].shape == (L, D, E)
+    assert b["moe_w1"].shape == (L, E, D, 4 * D)
+    assert b["moe_w2"].shape == (L, E, 4 * D, D)
+
+
+def test_moe_forward_and_loss_finite():
+    cfg = moe_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    idx = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg.vocab_size)
+    logits, loss = forward(cfg, params, idx, idx)
+    assert logits.shape == (2, 64, cfg.vocab_size)
+    assert np.isfinite(float(loss))
+    # Aux term present: loss with aux coefficient differs from pure CE.
+    import dataclasses
+
+    no_aux = dataclasses.replace(cfg, router_aux_coef=0.0)
+    _, ce_only = forward(no_aux, params, idx, idx)
+    assert float(loss) != float(ce_only)
+    # Aux is small and positive (load-balance ~1 at uniform routing).
+    assert 0 < float(loss) - float(ce_only) < 0.1
+
+
+def test_moe_trains():
+    import optax
+
+    cfg = moe_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    idx = jax.random.randint(jax.random.key(1), (4, 64), 0, cfg.vocab_size)
+    tx = optax.adamw(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        l, g = jax.value_and_grad(lambda p_: loss_fn(cfg, p_, idx, idx))(p)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, l
+
+    losses = []
+    for _ in range(8):
+        params, opt, l = step(params, opt)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] - 0.5, losses
+
+
+def test_expert_parallel_sharding(eight_devices):
+    cfg = moe_cfg()
+    mesh = make_mesh(
+        (2, 1, 1, 1, 4), ("data", "seq", "model", "pipe", "expert"),
+        devices=jax.devices(),
+    )
+    state = create_train_state(cfg, get_strategy("ddp"), mesh, seed=42)
+    w1 = state.params["blocks"]["moe_w1"]
+    assert tuple(state.param_specs["blocks"]["moe_w1"])[1] == "expert"
+    assert w1.sharding.shard_shape(w1.shape)[1] == cfg.n_experts // 4
+    # Router replicated.
+    r = state.params["blocks"]["router"]
+    assert r.sharding.shard_shape(r.shape) == r.shape
+
+
+def test_ep_trajectory_matches_single_device(eight_devices):
+    """Expert parallelism must not change the computation."""
+
+    def run(mesh_shape, n_devices):
+        cfg = moe_cfg()
+        mesh = make_mesh(
+            mesh_shape, ("data", "seq", "model", "pipe", "expert"),
+            devices=jax.devices()[:n_devices],
+        )
+        state = create_train_state(cfg, get_strategy("ddp"), mesh, seed=42)
+        ds = SyntheticDataset(vocab_size=cfg.vocab_size, seq_len=64, size=64)
+        losses, params, opt = [], state.params, state.opt_state
+        for step in range(3):
+            batch = ds.batch_for_step(step, 4).reshape(1, 4, 64)
+            batch = jax.device_put(batch, state.batch_sharding)
+            params, opt, loss = state.step_fn(params, opt, batch, step)
+            losses.append(float(loss))
+        return losses
+
+    base = run((1, 1, 1, 1, 1), 1)
+    ep = run((2, 1, 1, 1, 4), 8)
+    np.testing.assert_allclose(ep, base, rtol=2e-3)
+
+
+def test_moe_rejects_pipeline():
+    from distributed_llm_training_benchmark_framework_tpu.parallel.pipeline import (
+        pipeline_loss_fn,
+    )
+
+    cfg = moe_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(
+        (1, 1, 1, 2), ("data", "seq", "model", "pipe"), devices=jax.devices()[:2]
+    )
+    with pytest.raises(ValueError, match="MoE"):
+        pipeline_loss_fn(cfg, mesh, params, np.zeros((2, 1, 64), np.int32))
