@@ -77,6 +77,14 @@ def main():
         "value": round(per_chip, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(per_chip / REFERENCE_BEST_TOKENS_PER_SEC_PER_GPU, 3),
+        # Visibility extras (additive; the contract keys above are unchanged):
+        # exactly which semantics produced the number, and how far from peak.
+        "attention_impl": result.attention_impl,
+        "dropout": result.dropout,
+        "model_tflops_per_sec_per_chip": round(
+            result.model_tflops_per_sec_per_chip, 2
+        ),
+        "mfu_pct": round(result.mfu_pct, 2),
     }))
 
 

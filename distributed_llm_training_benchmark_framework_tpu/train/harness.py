@@ -14,8 +14,10 @@ Semantics mapping:
   jax.distributed coordinator contract.
 - ``--deepspeed-config``/``--fsdp-config`` are accepted aliases for
   ``--strategy-config`` pointing at ``configs/strategies/*.json`` (our live
-  format). A DeepSpeed-format JSON is detected and its live-equivalent knobs
-  honored via the built-in strategy defaults.
+  format). A DeepSpeed-format JSON is detected and *translated*: its
+  optimizer/scheduler/clipping/precision values are mapped into the
+  StrategyConfig (``parallel.strategies.from_deepspeed_config``), matching the
+  reference's behavior of reading and mutating the file at runtime.
 """
 
 from __future__ import annotations
@@ -26,6 +28,7 @@ import os
 import sys
 
 from ..parallel import get_strategy, load_strategy_config, STRATEGIES
+from ..parallel.strategies import from_deepspeed_config, is_deepspeed_config
 from ..runtime import distributed as dist
 
 
@@ -120,9 +123,15 @@ def resolve_strategy(args: argparse.Namespace):
                     f"--strategy {args.strategy} but config file is for {sc.name}"
                 )
             return sc
-        # DeepSpeed/foreign format: honor the arm via built-in defaults, which
-        # already encode the live-equivalent knobs of the reference configs.
-        print(f"Note: {path} is not a native strategy config; "
+        if is_deepspeed_config(raw):
+            # Honor the file's optimizer/scheduler/clipping values — the
+            # reference reads and mutates its DeepSpeed JSON at runtime
+            # (train_harness.py:246-262); "accepted alias" must not mean
+            # "accepted and discarded".
+            print(f"Note: translating DeepSpeed-format config {path} "
+                  f"into the {args.strategy!r} arm")
+            return from_deepspeed_config(raw, args.strategy)
+        print(f"Note: {path} is not a recognized strategy config format; "
               f"using built-in {args.strategy!r} defaults")
     return get_strategy(args.strategy)
 

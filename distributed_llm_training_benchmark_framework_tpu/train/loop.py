@@ -27,6 +27,7 @@ from ..data import SyntheticDataset
 from ..models import get_model_config
 from ..parallel import make_mesh, StrategyConfig
 from ..runtime import distributed as dist
+from ..utils import flops as flops_mod
 from ..utils import metrics as metrics_mod
 from .step import create_train_state
 
@@ -206,6 +207,8 @@ def run_benchmark(
         backend=jax.default_backend(),
         n_params=state.n_params,
         attention_impl=attention_impl,
+        dropout=model_config.dropout,
+        flops_per_token=flops_mod.train_flops_per_token(model_config),
         tensor_parallel=tp,
         sequence_parallel=sp,
         pipeline_parallel=pp,

@@ -1,0 +1,88 @@
+"""Analytic model-FLOPs accounting and MFU (model FLOPs utilization).
+
+The reference's metric surface stops at tokens/sec and a transfer proxy
+(reference ``benchmarking/train_harness.py:399-413``) — it never relates
+throughput to what the silicon could do. We add the standard accounting:
+
+- ``train_flops_per_token(config)``: analytic fwd+bwd FLOPs per token for the
+  TinyGPT architecture (matmul-dominated terms only, the PaLM/Chinchilla
+  convention). Backward is counted as 2x forward; rematerialized recompute is
+  deliberately NOT counted — MFU measures useful model FLOPs, so remat shows
+  up as lower MFU, not higher FLOPs.
+- ``device_peak_tflops(device_kind)``: bf16 peak per chip for known TPU
+  generations (public spec-sheet numbers).
+- MFU = achieved model TFLOP/s/chip ÷ peak TFLOP/s/chip.
+
+Counting detail (per token, forward):
+- per layer: QKV projection ``2*D*3D``, attention output projection ``2*D*D``,
+  MLP ``2*(D*4D + 4D*D)`` → ``24*D^2`` total matmul FLOPs;
+- attention itself: ``QK^T`` is S MACs per head-dim per key → ``2*S*D``, and
+  ``probs @ V`` another ``2*S*D`` → ``4*S*D`` per layer;
+- LM head (weight-tied, counted once): ``2*D*V``;
+- MoE variant: the MLP term runs ``top_k`` experts per token plus a
+  ``2*D*E`` router.
+
+Training multiplies forward by 3 (bwd ≈ 2x fwd for matmuls).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# bf16 peak TFLOP/s per chip, public spec numbers. Matched by substring
+# against jax's Device.device_kind (e.g. "TPU v5 lite", "TPU v4").
+# Order matters: more specific names first ("v5 lite" before "v5").
+_PEAK_TFLOPS_BF16 = (
+    ("TPU v6 lite", 918.0),  # Trillium / v6e
+    ("TPU v6", 918.0),
+    ("TPU v5 lite", 197.0),  # v5e
+    ("TPU v5e", 197.0),
+    ("TPU v5p", 459.0),
+    ("TPU v5", 459.0),
+    ("TPU v4 lite", 138.0),  # v4i
+    ("TPU v4", 275.0),
+    ("TPU v3", 123.0),
+    ("TPU v2", 45.0),
+)
+
+
+def device_peak_tflops(device_kind: str) -> Optional[float]:
+    """bf16 peak TFLOP/s for a device kind, or None if unknown (e.g. CPU)."""
+    for name, peak in _PEAK_TFLOPS_BF16:
+        if name.lower() in device_kind.lower():
+            return peak
+    return None
+
+
+def forward_flops_per_token(config) -> float:
+    """Analytic forward-pass FLOPs per token for a TinyGPTConfig."""
+    D, L, V, S = config.n_embd, config.n_layer, config.vocab_size, config.block_size
+    if getattr(config, "n_experts", 0) > 0:
+        mlp = 2 * config.expert_top_k * (8 * D * D) + 2 * D * config.n_experts
+    else:
+        mlp = 16 * D * D
+    per_layer = (
+        6 * D * D  # QKV projection
+        + 2 * D * D  # attention output projection
+        + mlp
+        + 4 * S * D  # QK^T and probs@V
+    )
+    return float(L * per_layer + 2 * D * V)
+
+
+def train_flops_per_token(config) -> float:
+    """fwd+bwd FLOPs per token (bwd = 2x fwd; remat recompute not counted)."""
+    return 3.0 * forward_flops_per_token(config)
+
+
+def mfu_pct(
+    tokens_per_sec_per_chip: float,
+    flops_per_token: float,
+    device_kind: str,
+) -> Optional[float]:
+    """Model-FLOPs utilization in percent, or None for unknown device kinds."""
+    peak = device_peak_tflops(device_kind)
+    if peak is None or flops_per_token <= 0 or tokens_per_sec_per_chip <= 0:
+        return None
+    achieved_tflops = tokens_per_sec_per_chip * flops_per_token / 1e12
+    return 100.0 * achieved_tflops / peak

@@ -78,6 +78,12 @@ class BenchmarkResult:
     backend: str = ""
     n_params: int = 0
     attention_impl: str = "reference"
+    dropout: float = 0.0
+    # Analytic model-FLOPs accounting (utils.flops); the reference has no
+    # FLOPs metric at all (train_harness.py:399-413 is its whole surface).
+    flops_per_token: float = 0.0
+    model_tflops_per_sec_per_chip: float = 0.0
+    mfu_pct: float = 0.0  # 0.0 when the device kind's peak is unknown (CPU)
     tensor_parallel: int = 1
     sequence_parallel: int = 1
     pipeline_parallel: int = 1
@@ -110,6 +116,8 @@ def compute_result(
     backend: str = "",
     n_params: int = 0,
     attention_impl: str = "reference",
+    dropout: float = 0.0,
+    flops_per_token: float = 0.0,
     tensor_parallel: int = 1,
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
@@ -132,6 +140,11 @@ def compute_result(
     h2d = (bytes_per_step / mean_step) / 1e9 if mean_step > 0 else 0.0
     peak = peak_hbm_bytes()
     peak_gb = (peak or 0) / 1e9
+    from . import flops as flops_mod
+
+    tps_per_chip = tps / world_size if world_size else 0.0
+    tflops_per_chip = tps_per_chip * flops_per_token / 1e12
+    mfu = flops_mod.mfu_pct(tps_per_chip, flops_per_token, device_kind)
     return BenchmarkResult(
         strategy=strategy,
         world_size=world_size,
@@ -151,6 +164,10 @@ def compute_result(
         backend=backend,
         n_params=n_params,
         attention_impl=attention_impl,
+        dropout=dropout,
+        flops_per_token=flops_per_token,
+        model_tflops_per_sec_per_chip=tflops_per_chip,
+        mfu_pct=mfu if mfu is not None else 0.0,
         tensor_parallel=tensor_parallel,
         sequence_parallel=sequence_parallel,
         pipeline_parallel=pipeline_parallel,
@@ -171,6 +188,11 @@ def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True)
     print("\n" + "=" * 80)
     print("Benchmark Results:")
     print(f"  Tokens/sec:       {result.tokens_per_sec:,.0f}")
+    if result.mfu_pct > 0:
+        print(
+            f"  Model TFLOP/s/chip: {result.model_tflops_per_sec_per_chip:.1f}"
+            f"  (MFU {result.mfu_pct:.1f}%)"
+        )
     print(f"  Mean step time:   {result.mean_step_time_sec:.4f}s")
     print(f"  Peak HBM/chip:    {result.peak_hbm_gb:.2f} GB")
     print(f"  H2D GB/s/chip:    {result.h2d_gbps_per_gpu:.3f}")
