@@ -187,6 +187,16 @@ def from_deepspeed_config(raw: Dict[str, Any], strategy_name: str) -> StrategyCo
     """
     base = get_strategy(strategy_name)
 
+    def section(key):
+        """A config section must be a dict (or absent); fail naming the key
+        rather than AttributeError-ing on shorthand like {"bf16": true}."""
+        val = raw.get(key, {})
+        if not isinstance(val, dict):
+            raise ValueError(
+                f"DeepSpeed config section {key!r} must be an object, got {val!r}"
+            )
+        return val
+
     def num(container, key, fallback, cast=float):
         """Read a numeric field; HF-Trainer-style "auto" (ubiquitous in real
         DeepSpeed JSONs) falls back to the arm default; anything else
@@ -201,7 +211,7 @@ def from_deepspeed_config(raw: Dict[str, Any], strategy_name: str) -> StrategyCo
                 f"DeepSpeed config field {key!r} has non-numeric value {val!r}"
             )
 
-    zero = raw.get("zero_optimization", {})
+    zero = section("zero_optimization")
     stage = num(zero, "stage", None, int)
     expected = {"zero2": 2, "zero3": 3}.get(strategy_name)
     if stage is not None and expected is not None and stage != expected:
@@ -209,9 +219,28 @@ def from_deepspeed_config(raw: Dict[str, Any], strategy_name: str) -> StrategyCo
             f"--strategy {strategy_name} but DeepSpeed config sets "
             f"zero_optimization.stage={stage}"
         )
-    opt = raw.get("optimizer", {}).get("params", {})
-    sched = raw.get("scheduler", {})
+    opt_section = section("optimizer")
+    opt_type = opt_section.get("type", "AdamW")
+    if str(opt_type).lower() not in ("adam", "adamw"):
+        # The framework's optimizer recipe is AdamW (reference parity);
+        # silently running AdamW under an SGD/Lamb config would be wrong
+        # semantics at a likely-diverging lr.
+        raise ValueError(
+            f"DeepSpeed optimizer type {opt_type!r} is not supported "
+            "(only Adam/AdamW map onto this framework's optimizer)"
+        )
+    opt = opt_section.get("params", {})
+    if not isinstance(opt, dict):
+        raise ValueError(
+            f"DeepSpeed config field 'optimizer.params' must be an object, got {opt!r}"
+        )
+    sched = section("scheduler")
     sched_params = sched.get("params", {})
+    if not isinstance(sched_params, dict):
+        raise ValueError(
+            f"DeepSpeed config field 'scheduler.params' must be an object, "
+            f"got {sched_params!r}"
+        )
     warmup = base.warmup_steps
     # Only warmup-family schedulers carry warmup_num_steps semantics we map.
     if sched.get("type", "WarmupLR") in ("WarmupLR", "WarmupDecayLR"):
@@ -226,8 +255,13 @@ def from_deepspeed_config(raw: Dict[str, Any], strategy_name: str) -> StrategyCo
     ):
         raise ValueError(f"DeepSpeed config field 'betas' must be [b1, b2], got {betas!r}")
     precision = base.precision
-    if raw.get("bf16", {}).get("enabled") or raw.get("fp16", {}).get("enabled"):
+    if section("bf16").get("enabled") or section("fp16").get("enabled"):
         precision = "bf16"
+    grad_clip = num(raw, "gradient_clipping", base.grad_clip)
+    if grad_clip is not None and grad_clip <= 0:
+        # DeepSpeed semantics: gradient_clipping 0 means *disabled*, not
+        # "clip everything to zero norm".
+        grad_clip = None
     return dataclasses.replace(
         base,
         learning_rate=num(opt, "lr", base.learning_rate),
@@ -235,7 +269,7 @@ def from_deepspeed_config(raw: Dict[str, Any], strategy_name: str) -> StrategyCo
         eps=num(opt, "eps", base.eps),
         weight_decay=num(opt, "weight_decay", base.weight_decay),
         warmup_steps=warmup,
-        grad_clip=num(raw, "gradient_clipping", base.grad_clip),
+        grad_clip=grad_clip,
         precision=precision,
     )
 

@@ -85,13 +85,12 @@ def is_main_process() -> bool:
 
 def barrier(name: str = "benchmark_end") -> None:
     """Cross-host barrier before final metrics (parity: dist.barrier(),
-    reference train_harness.py:396-397). A tiny psum over all devices is the
-    XLA-native barrier; single-process it is a no-op."""
+    reference train_harness.py:396-397). Uses the jit/GSPMD-era
+    ``sync_global_devices`` (an all-gather across every device, keyed by
+    ``name`` so mismatched barrier call sites across hosts fail loudly instead
+    of deadlocking); single-process it is a no-op."""
     if jax.process_count() == 1:
         return
-    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
 
-    x = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
-        jnp.ones((jax.local_device_count(),))
-    )
-    jax.block_until_ready(x)
+    multihost_utils.sync_global_devices(name)

@@ -161,7 +161,17 @@ def run_benchmark(
             trace_started = True
         batch = ds.batch_for_step(step, global_micro * grad_accum)
         batch = batch.reshape(grad_accum, global_micro, seq_len)
-        batch = jax.device_put(batch, state.batch_sharding)
+        if jax.process_count() > 1:
+            # Every process computed the identical global batch (the dataset
+            # is a pure function of the step); each contributes the shards it
+            # can address. device_put can't target non-addressable devices.
+            host_batch = batch
+            batch = jax.make_array_from_callback(
+                host_batch.shape, state.batch_sharding,
+                lambda idx: host_batch[idx],
+            )
+        else:
+            batch = jax.device_put(batch, state.batch_sharding)
 
         t0 = time.perf_counter()
         params, opt_state, loss = state.step_fn(params, opt_state, batch, step)

@@ -75,6 +75,13 @@ def train_flops_per_token(config) -> float:
     return 3.0 * forward_flops_per_token(config)
 
 
+def achieved_tflops_per_sec(
+    tokens_per_sec_per_chip: float, flops_per_token: float
+) -> float:
+    """Model TFLOP/s per chip actually delivered at a given throughput."""
+    return tokens_per_sec_per_chip * flops_per_token / 1e12
+
+
 def mfu_pct(
     tokens_per_sec_per_chip: float,
     flops_per_token: float,
@@ -84,5 +91,4 @@ def mfu_pct(
     peak = device_peak_tflops(device_kind)
     if peak is None or flops_per_token <= 0 or tokens_per_sec_per_chip <= 0:
         return None
-    achieved_tflops = tokens_per_sec_per_chip * flops_per_token / 1e12
-    return 100.0 * achieved_tflops / peak
+    return 100.0 * achieved_tflops_per_sec(tokens_per_sec_per_chip, flops_per_token) / peak

@@ -143,7 +143,7 @@ def compute_result(
     from . import flops as flops_mod
 
     tps_per_chip = tps / world_size if world_size else 0.0
-    tflops_per_chip = tps_per_chip * flops_per_token / 1e12
+    tflops_per_chip = flops_mod.achieved_tflops_per_sec(tps_per_chip, flops_per_token)
     mfu = flops_mod.mfu_pct(tps_per_chip, flops_per_token, device_kind)
     return BenchmarkResult(
         strategy=strategy,

@@ -109,6 +109,33 @@ def test_non_numeric_field_fails_naming_the_key():
         )
 
 
+def test_gradient_clipping_zero_means_disabled():
+    # DeepSpeed defines gradient_clipping 0 as "disabled"; translating it to
+    # clip_by_global_norm(0.0) would zero every gradient silently.
+    sc = from_deepspeed_config(
+        {"bf16": {"enabled": True}, "gradient_clipping": 0}, "zero2"
+    )
+    assert sc.grad_clip is None
+
+
+def test_non_adam_optimizer_type_rejected():
+    with pytest.raises(ValueError, match="SGD"):
+        from_deepspeed_config(
+            {"bf16": {"enabled": True},
+             "optimizer": {"type": "SGD", "params": {"lr": 0.1}}},
+            "zero2",
+        )
+
+
+def test_non_dict_sections_fail_naming_the_key():
+    with pytest.raises(ValueError, match="'bf16'"):
+        from_deepspeed_config({"bf16": True}, "zero2")
+    with pytest.raises(ValueError, match="'optimizer'"):
+        from_deepspeed_config(
+            {"gradient_clipping": 1.0, "optimizer": "AdamW"}, "zero2"
+        )
+
+
 def test_non_warmup_scheduler_type_is_not_mapped():
     raw = {
         "bf16": {"enabled": True},
