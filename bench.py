@@ -40,6 +40,10 @@ def main():
     p.add_argument("--attention", default="flash",
                    choices=["reference", "flash", "ring"])
     p.add_argument("--dropout", type=float, default=None)
+    # Hard-sync every N steps instead of every step: totals are identical
+    # (steps are device-sequential), but host RPC latency stays out of the
+    # hot loop — see the timing-discipline note in train/loop.py.
+    p.add_argument("--sync-every", type=int, default=10)
     args = p.parse_args()
 
     from distributed_llm_training_benchmark_framework_tpu.utils.platform import (
@@ -69,6 +73,7 @@ def main():
             results_dir=None,
             attention_impl=args.attention,
             dropout=args.dropout,
+            sync_every=args.sync_every,
         )
 
     per_chip = result.tokens_per_sec / world

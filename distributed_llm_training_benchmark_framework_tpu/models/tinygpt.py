@@ -57,6 +57,12 @@ class TinyGPTConfig:
     # 'reference' = jnp softmax attention; 'flash' = Pallas TPU kernel;
     # 'ring' = ring attention over a sequence-parallel mesh axis.
     attention_impl: str = "reference"
+    # Flash-kernel tile sizes (None = kernel's tuned default). Exposed as a
+    # real tuning surface (--flash-block-q/k/k-bwd) because the optima are
+    # device-generation dependent — and differ between forward and backward.
+    flash_block_q: Optional[int] = None
+    flash_block_k: Optional[int] = None
+    flash_block_k_bwd: Optional[int] = None
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     # Per-layer rematerialization (activation checkpointing) inside the scan.
@@ -223,7 +229,11 @@ def _attention(
         # Pallas TPU kernel; fp32 online-softmax accumulation internally.
         from ..ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=config.causal)
+        return flash_attention(
+            q, k, v, causal=config.causal,
+            block_q=config.flash_block_q, block_k=config.flash_block_k,
+            block_k_bwd=config.flash_block_k_bwd,
+        )
     if config.attention_impl == "ring":
         from ..ops.ring_attention import ring_attention
 

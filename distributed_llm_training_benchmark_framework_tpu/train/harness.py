@@ -78,12 +78,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dropout", type=float, default=None,
                    help="Override model dropout rate (default: tier's 0.1, "
                         "parity with the reference model)")
+    p.add_argument("--flash-block-q", type=int, default=None,
+                   help="Flash-attention q tile size (default: kernel-tuned)")
+    p.add_argument("--flash-block-k", type=int, default=None,
+                   help="Flash-attention k tile size (default: kernel-tuned)")
+    p.add_argument("--flash-block-k-bwd", type=int, default=None,
+                   help="Flash-attention backward k tile size (the fwd/bwd "
+                        "optima differ; default: kernel-tuned)")
     # Training
     p.add_argument("--steps", type=int, required=True)
     p.add_argument("--warmup-steps", type=int, default=5)
     p.add_argument("--per-device-batch", type=int, required=True)
     p.add_argument("--grad-accum", type=int, required=True)
     p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--sync-every", type=int, default=1,
+                   help="Hard-sync (block on loss) every N steps; 1 = the "
+                        "reference's per-step discipline, N>1 keeps host RPC "
+                        "latency out of the timed loop on slow host links")
     # Configs
     p.add_argument("--strategy-config", type=str, default=None,
                    help="Path to a configs/strategies/*.json file")
@@ -188,7 +199,11 @@ def main(argv=None) -> int:
             seed=args.seed,
             attention_impl=args.attention,
             dropout=args.dropout,
+            flash_block_q=args.flash_block_q,
+            flash_block_k=args.flash_block_k,
+            flash_block_k_bwd=args.flash_block_k_bwd,
             dataset_size=args.dataset_size,
+            sync_every=args.sync_every,
             profile_dir=args.profile_dir,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,

@@ -52,8 +52,12 @@ def run_benchmark(
     seed: int = 42,
     attention_impl: str = "reference",
     dropout: Optional[float] = None,
+    flash_block_q: Optional[int] = None,
+    flash_block_k: Optional[int] = None,
+    flash_block_k_bwd: Optional[int] = None,
     dataset_size: int = 1000,
     log_every: int = 10,
+    sync_every: int = 1,
     profile_dir: Optional[str] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 0,
@@ -104,6 +108,12 @@ def run_benchmark(
     overrides = {} if dropout is None else {"dropout": dropout}
     if n_experts > 0:
         overrides["n_experts"] = n_experts
+    if flash_block_q is not None:
+        overrides["flash_block_q"] = flash_block_q
+    if flash_block_k is not None:
+        overrides["flash_block_k"] = flash_block_k
+    if flash_block_k_bwd is not None:
+        overrides["flash_block_k_bwd"] = flash_block_k_bwd
     model_config = get_model_config(
         tier, seq_len, attention_impl=attention_impl, **overrides
     )
@@ -121,9 +131,15 @@ def run_benchmark(
             f"Mesh: {dict(mesh.shape)} over {devices[0].device_kind!r} devices"
         )
 
+    # Data-parallel width sets the global microbatch; tp/sp groups share
+    # replicas of each example (matching how the reference's world_size
+    # multiplies per-device batch for pure DP, reference train_harness.py:403).
+    global_micro = per_device_batch * dp
+
     t_init = time.perf_counter()
     state = create_train_state(
-        model_config, strategy, mesh, seed=seed, grad_accum=grad_accum
+        model_config, strategy, mesh, seed=seed, grad_accum=grad_accum,
+        from_table=True, global_micro=global_micro, seq_len=seq_len,
     )
     if is_main:
         print(f"Model initialized: {state.n_params/1e6:.2f}M parameters")
@@ -135,10 +151,18 @@ def run_benchmark(
     if is_main:
         print(f"SyntheticDataset: {dataset_size} samples, seq_len={seq_len}")
 
-    # Data-parallel width sets the global microbatch; tp/sp groups share
-    # replicas of each example (matching how the reference's world_size
-    # multiplies per-device batch for pure DP, reference train_harness.py:403).
-    global_micro = per_device_batch * dp
+    # The dataset table lives on-device for the whole run (8 MB at reference
+    # scale): per-step batches are gathered inside the jitted step from the
+    # step index, so the hot loop performs zero host->device transfers.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    replicated = NamedSharding(mesh, P())
+    if jax.process_count() > 1:
+        table = jax.make_array_from_callback(
+            ds.data.shape, replicated, lambda idx: ds.data[idx]
+        )
+    else:
+        table = jax.device_put(ds.data, replicated)
     params, opt_state = state.params, state.opt_state
     step_times, losses = [], []
     trace_started = False
@@ -155,42 +179,58 @@ def run_benchmark(
             if is_main:
                 print(f"Resumed from checkpoint at step {start_step - 1}")
 
+    # Timing discipline. Steps are data-dependent (params chain through the
+    # jitted step), so the device necessarily executes them back-to-back;
+    # blocking on a step's loss therefore fences every step dispatched before
+    # it. With sync_every=1 (default — the reference's per-step loss.item()
+    # discipline, train_harness.py:390) each step is timed individually;
+    # with sync_every=N the loop hard-syncs every N steps and each step in
+    # the window is assigned the window's mean — the totals are identical,
+    # but N>1 keeps host round-trip latency (dispatch + sync RPCs) out of
+    # the hot loop, which matters when the host link is slow.
+    pending: list = []  # (step, loss_handle) since last sync
+
+    def sync_window(t_start):
+        """Block on the window's last loss; distribute wall time evenly."""
+        if not pending:
+            return
+        jax.block_until_ready(pending[-1][1])
+        dt = (time.perf_counter() - t_start) / len(pending)
+        for s, l in pending:
+            if s >= warmup_steps:
+                step_times.append(dt)
+                losses.append(float(l))
+            if is_main and s % log_every == 0:
+                print(f"[Step {s:04d}] Loss: {float(l):.4f}, Time: {dt:.3f}s")
+        pending.clear()
+
+    t_window = time.perf_counter()
     for step in range(start_step, steps):
         if profile_dir and step == warmup_steps and is_main and not trace_started:
+            sync_window(t_window)
             jax.profiler.start_trace(profile_dir)
             trace_started = True
-        batch = ds.batch_for_step(step, global_micro * grad_accum)
-        batch = batch.reshape(grad_accum, global_micro, seq_len)
-        if jax.process_count() > 1:
-            # Every process computed the identical global batch (the dataset
-            # is a pure function of the step); each contributes the shards it
-            # can address. device_put can't target non-addressable devices.
-            host_batch = batch
-            batch = jax.make_array_from_callback(
-                host_batch.shape, state.batch_sharding,
-                lambda idx: host_batch[idx],
-            )
-        else:
-            batch = jax.device_put(batch, state.batch_sharding)
-
-        t0 = time.perf_counter()
-        params, opt_state, loss = state.step_fn(params, opt_state, batch, step)
-        loss = jax.block_until_ready(loss)  # honest wall-clock under async dispatch
-        t1 = time.perf_counter()
-
-        step_time = t1 - t0
-        if step >= warmup_steps:
-            step_times.append(step_time)
-            losses.append(float(loss))
-        if is_main and step % log_every == 0:
-            print(f"[Step {step:04d}] Loss: {float(loss):.4f}, Time: {step_time:.3f}s")
-        # Checkpointing happens outside the timed region (t0..t1 above), so
-        # benchmark step times stay honest.
+            t_window = time.perf_counter()
+        if step == warmup_steps and sync_every > 1:
+            # Warmup excluded from averages; fence so its tail doesn't leak
+            # into the first timed window.
+            sync_window(t_window)
+            t_window = time.perf_counter()
+        params, opt_state, loss = state.step_fn(params, opt_state, table, step)
+        pending.append((step, loss))
+        if len(pending) >= sync_every or step == steps - 1:
+            sync_window(t_window)
+            t_window = time.perf_counter()
+        # Checkpointing happens at a sync boundary, outside the next timed
+        # window, so benchmark step times stay honest.
         if ckpt is not None and ckpt.should_save(step):
+            sync_window(t_window)
             ckpt.save(step, params, opt_state)
             if is_main:
                 print(f"Checkpoint saved at step {step}")
+            t_window = time.perf_counter()
 
+    sync_window(t_window)
     if ckpt is not None:
         # Final save only if this run actually executed steps — a resume that
         # had nothing left to do must not relabel later-step state.

@@ -86,11 +86,22 @@ def make_train_step(
     grad_accum: int = 1,
     seed: int = 0,
     deterministic_dropout: bool = False,
+    from_table: bool = False,
+    global_micro: int = 1,
+    seq_len: int = 0,
 ) -> Callable:
     """Build the jitted train step for one strategy arm.
 
     batch layout: (grad_accum, global_microbatch, seq_len) int32; targets are
     the inputs themselves (parity: reference ``train_harness.py:359``).
+
+    ``from_table=True`` switches the third argument from a per-step batch to
+    the whole device-resident dataset table (size, seq_len); the step's batch
+    rows are gathered *inside* the jitted step from the step index. This
+    removes every per-step host->device transfer from the hot loop — the
+    TPU-native answer to the reference's DataLoader (whose synthetic tensor
+    also lives device-side after first touch). Requires ``global_micro`` and
+    ``seq_len`` for the gather geometry.
     """
     cfg = _resolve_model_config(model_config, strategy, mesh)
     grad_sharded_specs = strat.param_partition_specs(
@@ -117,6 +128,17 @@ def make_train_step(
         from ..parallel.pipeline import pipeline_loss_fn
 
     def train_step(params, opt_state, batch, step):
+        if from_table:
+            # batch is the dataset table: gather this step's rows on-device.
+            table = batch
+            G = grad_accum * global_micro
+            rows = (step * G + jnp.arange(G)) % table.shape[0]
+            batch = jnp.take(table, rows, axis=0).reshape(
+                grad_accum, global_micro, seq_len
+            )
+            batch = lax.with_sharding_constraint(
+                batch, NamedSharding(mesh, full_batch_spec)
+            )
         base_key = jax.random.fold_in(jax.random.key(seed), step)
 
         def one_micro(carry, inp):
@@ -168,7 +190,8 @@ def make_train_step(
         in_shardings=(
             strat.named(mesh, param_specs),
             strat.named(mesh, opt_specs),
-            NamedSharding(mesh, full_batch_spec),
+            NamedSharding(mesh, P()) if from_table
+            else NamedSharding(mesh, full_batch_spec),
             None,
         ),
         out_shardings=(
@@ -195,6 +218,9 @@ def create_train_state(
     seed: int = 42,
     grad_accum: int = 1,
     deterministic_dropout: bool = False,
+    from_table: bool = False,
+    global_micro: int = 1,
+    seq_len: int = 0,
 ) -> TrainState:
     """Initialize params + optimizer state directly into their target shardings.
 
@@ -234,6 +260,9 @@ def create_train_state(
         grad_accum=grad_accum,
         seed=seed,
         deterministic_dropout=deterministic_dropout,
+        from_table=from_table,
+        global_micro=global_micro,
+        seq_len=seq_len,
     )
     return TrainState(
         params=params,

@@ -19,6 +19,7 @@ IMAGE="tpu-llm-bench:latest"
 TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
 NAMESPACE="bench"
+JOB_NAME="tpu-bench"
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -32,6 +33,7 @@ while [ $# -gt 0 ]; do
     --grad-accum) GRAD_ACCUM="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
+    --job-name) JOB_NAME="$2"; shift 2 ;;
     *) echo "unknown flag $1"; exit 1 ;;
   esac
 done
@@ -44,12 +46,13 @@ if [ $(( TPU_PER_HOST * NUM_HOSTS )) -ne "$WORLD_SIZE" ]; then
   echo "ERROR: world-size $WORLD_SIZE not divisible by num-hosts $NUM_HOSTS"; exit 1
 fi
 
-echo "Launching: strategy=$STRATEGY world_size=$WORLD_SIZE hosts=$NUM_HOSTS"
+echo "Launching: job=$JOB_NAME strategy=$STRATEGY world_size=$WORLD_SIZE hosts=$NUM_HOSTS"
 kubectl apply -f k8s/namespace.yaml
 kubectl apply -f k8s/serviceaccount.yaml
 kubectl apply -f k8s/service-coordinator.yaml
 
-sed -e "s|{{STRATEGY}}|$STRATEGY|g" \
+sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
+    -e "s|{{STRATEGY}}|$STRATEGY|g" \
     -e "s|{{WORLD_SIZE}}|$WORLD_SIZE|g" \
     -e "s|{{NUM_HOSTS}}|$NUM_HOSTS|g" \
     -e "s|{{TPU_PER_HOST}}|$TPU_PER_HOST|g" \

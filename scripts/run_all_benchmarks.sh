@@ -89,22 +89,27 @@ run_local() {
 
 run_k8s() {
   local strategy="$1" ws="$2"
-  local name="bench-${strategy}-ws${ws}-seq${SEQ_LEN}"
-  echo "--- $name (k8s) ---"
+  # Unique job name per run: the collector scrapes into
+  # $RESULTS_DIR/<job>_results, so a shared name would make each of the
+  # matrix runs overwrite the previous one's result.json (pod filesystems
+  # are ephemeral — the scrape is the only copy).
+  local job="tpu-bench-${strategy}-ws${ws}"
+  echo "--- $job (k8s) ---"
   scripts/launch_multi.sh --strategy "$strategy" --world-size "$ws" \
     --seq-len "$SEQ_LEN" --tier "$TIER" --steps "$STEPS" \
     --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
+    --job-name "$job" \
     ${IMAGE:+--image "$IMAGE"}
   if kubectl -n "$NAMESPACE" wait --for=condition=complete \
-       "job/tpu-bench" --timeout=900s; then
-    scripts/collect_results.sh --k8s "$NAMESPACE" "tpu-bench" "$RESULTS_DIR"
+       "job/$job" --timeout=900s; then
+    scripts/collect_results.sh --k8s "$NAMESPACE" "$job" "$RESULTS_DIR"
     PASS=$((PASS+1))
   else
     echo "FAILED — last 100 log lines:"
-    kubectl -n "$NAMESPACE" logs -l job-name=tpu-bench --tail=100 || true
+    kubectl -n "$NAMESPACE" logs -l "job-name=$job" --tail=100 || true
     FAIL=$((FAIL+1))
   fi
-  kubectl -n "$NAMESPACE" delete job tpu-bench --ignore-not-found
+  kubectl -n "$NAMESPACE" delete job "$job" --ignore-not-found
 }
 
 for strategy in $STRATEGIES; do
