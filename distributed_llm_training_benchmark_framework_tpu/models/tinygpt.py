@@ -219,20 +219,28 @@ def _attention(
 ) -> jax.Array:
     """Dispatch to the configured attention implementation. Returns (B,S,H,Dh).
 
-    Note: the flash and ring kernels do not apply attention-probability
-    dropout (embedding/MLP dropout still applies) — the probabilities never
-    materialize, which is the point of those kernels. The harness prints this
-    deviation when benchmarking with dropout > 0, and cross-impl comparisons
-    should set dropout=0 for exact parity.
+    Attention-probability dropout (reference train_harness.py:116) applies in
+    the reference impl AND in the flash kernel (in-kernel, hash-based mask —
+    the probabilities still never materialize in HBM). The two draw from
+    different RNG streams (bernoulli vs coordinate hash), so with dropout > 0
+    flash-vs-reference parity is statistical, not per-step exact; set
+    dropout=0 for exact cross-impl loss comparison. The ring kernel applies
+    no attention dropout at all (documented deviation; the harness prints a
+    note).
     """
     if config.attention_impl == "flash":
         # Pallas TPU kernel; fp32 online-softmax accumulation internally.
         from ..ops.flash_attention import flash_attention
 
+        seed = None
+        if not deterministic and config.dropout > 0.0 and dropout_key is not None:
+            seed = jax.random.bits(dropout_key, (), jnp.uint32)
         return flash_attention(
             q, k, v, causal=config.causal,
             block_q=config.flash_block_q, block_k=config.flash_block_k,
             block_k_bwd=config.flash_block_k_bwd,
+            dropout_rate=config.dropout if seed is not None else 0.0,
+            dropout_seed=seed,
         )
     if config.attention_impl == "ring":
         from ..ops.ring_attention import ring_attention

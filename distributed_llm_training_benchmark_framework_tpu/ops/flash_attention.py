@@ -33,11 +33,42 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """32-bit integer finalizer (murmur3-style avalanche) on uint32 lanes."""
+    x = x.astype(jnp.uint32)
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def _dropout_keep(seed, bh, rows, cols, seq_len: int, threshold) -> jax.Array:
+    """Deterministic per-element keep mask for attention-probability dropout.
+
+    Derived from the absolute (batch*head, row, col) coordinate — NOT from
+    block indices or a stateful PRNG — so the forward kernel, the jnp
+    blockwise backward, and the Pallas backward kernels reproduce the exact
+    same mask even though they tile the (S, S) matrix differently.
+    ``seed`` is a traced uint32 scalar; ``threshold`` = keep_prob * 2^32.
+    """
+    base = _mix32(seed + jnp.uint32(bh) * jnp.uint32(0x9E3779B9))
+    h = _mix32(
+        base
+        + rows.astype(jnp.uint32) * jnp.uint32(seq_len)
+        + cols.astype(jnp.uint32)
+    )
+    return h < threshold
+
+
+def _dropout_threshold(rate: float) -> jnp.uint32:
+    return jnp.uint32(min(int((1.0 - rate) * 2**32), 2**32 - 1))
 
 
 def _pick_block(seq_len: int, preferred: int = 512) -> int:
@@ -58,9 +89,11 @@ _BWD_BLOCK_K = 512
 
 
 def _flash_fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, bq: int, bk: int, scale: float, causal: bool,
+    seq_len: int, dropout_rate: float,
 ):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -86,9 +119,9 @@ def _flash_fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (bq, bk) fp32
 
+        rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
-            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             mask = rows >= cols
             s = jnp.where(mask, s, NEG_INF)
 
@@ -100,10 +133,24 @@ def _flash_fwd_kernel(
         if causal:
             p = jnp.where(mask, p, 0.0)
 
+        # Attention-probability dropout (parity with the reference model,
+        # train_harness.py:114-116): the softmax normalizer l accumulates the
+        # UN-dropped p (dropout acts after normalization, and normalization is
+        # linear, so dropping the unnormalized p against the full-l divisor is
+        # exact), while the output accumulator sees the dropped+rescaled p.
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(
+                seed_ref[0], bh, rows, cols, seq_len,
+                _dropout_threshold(dropout_rate),
+            )
+            p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+        else:
+            p_acc = p
+
         l_prev = l_scr[:, :1]
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + lax.dot_general(
-            p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
+            p_acc.astype(q.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -123,14 +170,18 @@ def _flash_fwd_kernel(
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool, interpret: bool, bq: int, bk: int,
+    dropout_rate: float = 0.0, seed: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Run the Pallas kernel on (BH, S, D) inputs -> (out, lse)."""
     BH, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
     grid = (BH, S // bq, S // bk)
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.uint32)
     out, lse = pl.pallas_call(
         functools.partial(
-            _flash_fwd_kernel, bq=bq, bk=bk, scale=scale, causal=causal
+            _flash_fwd_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
+            seq_len=S, dropout_rate=dropout_rate,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -138,6 +189,7 @@ def _flash_forward(
         ],
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # dropout seed (1,) uint32
             pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
@@ -155,28 +207,32 @@ def _flash_forward(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v)
+    )(seed, q, k, v)
     return out, lse[:, 0, :]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def _flash(opts: Tuple, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    causal, interpret, bq, bk, _, _ = opts
-    out, _ = _flash_forward(q, k, v, causal, interpret, bq, bk)
+def _flash(
+    opts: Tuple, q: jax.Array, k: jax.Array, v: jax.Array, seed: jax.Array
+) -> jax.Array:
+    causal, interpret, bq, bk, _, _, rate = opts
+    out, _ = _flash_forward(q, k, v, causal, interpret, bq, bk, rate, seed)
     return out
 
 
-def _flash_fwd_rule(opts, q, k, v):
-    causal, interpret, bq, bk, _, _ = opts
-    out, lse = _flash_forward(q, k, v, causal, interpret, bq, bk)
-    return out, (q, k, v, out, lse)
+def _flash_fwd_rule(opts, q, k, v, seed):
+    causal, interpret, bq, bk, _, _, rate = opts
+    out, lse = _flash_forward(q, k, v, causal, interpret, bq, bk, rate, seed)
+    return out, (q, k, v, out, lse, seed)
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc,
+    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc,
     *, bq: int, bk: int, scale: float, causal: bool,
+    seq_len: int, dropout_rate: float,
 ):
     """dq = sum over k blocks of ds @ k, ds = p * (dp - delta) * scale."""
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -198,9 +254,9 @@ def _bwd_dq_kernel(
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
-            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             mask = rows >= cols
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
@@ -209,6 +265,12 @@ def _bwd_dq_kernel(
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(
+                seed_ref[0], bh, rows, cols, seq_len,
+                _dropout_threshold(dropout_rate),
+            )
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         acc[:] = acc[:] + lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -220,11 +282,13 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, bq: int, bk: int, scale: float, causal: bool,
+    seq_len: int, dropout_rate: float,
 ):
-    """dk = sum over q blocks of ds^T @ q; dv = sum of p^T @ do."""
+    """dk = sum over q blocks of ds^T @ q; dv = sum of (D∘p)^T @ do."""
+    bh = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -247,20 +311,30 @@ def _bwd_dkv_kernel(
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
+        rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         if causal:
-            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             mask = rows >= cols
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         if causal:
             p = jnp.where(mask, p, 0.0)
-        pc = p.astype(q.dtype)
-        dv_acc[:] = dv_acc[:] + lax.dot_general(
-            pc, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(
+                seed_ref[0], bh, rows, cols, seq_len,
+                _dropout_threshold(dropout_rate),
+            )
+            inv = 1.0 / (1.0 - dropout_rate)
+            pd = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        else:
+            pd = p
+        dv_acc[:] = dv_acc[:] + lax.dot_general(
+            pd.astype(q.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
         dk_acc[:] = dk_acc[:] + lax.dot_general(
@@ -273,15 +347,22 @@ def _bwd_dkv_kernel(
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _jnp_blockwise_bwd(causal, bk, res, do):
+def _jnp_blockwise_bwd(causal, bk, rate, res, do):
     """Blockwise flash backward as batched einsums over a K-block scan.
 
     Same math as the Pallas kernels below, expressed as XLA-fused dense
     einsums: only (S, bk) tiles materialize. Measured FASTER than the Pallas
     backward on v5e (XLA schedules the batched-over-heads contractions onto
     the MXU better than the per-(head, tile) kernel grid) — hence the default.
+
+    With dropout (out = (D∘P) @ V, D = keep/keep_prob): dV = (D∘P)^T dO, and
+    the softmax-Jacobian identity dS = P∘(D∘dP - delta) still holds with
+    delta = rowsum(dO∘out) because rowsum((D∘P)∘dP) = rowsum(dO∘out). The
+    keep mask is regenerated from the same absolute-coordinate hash as the
+    forward kernel, so the decomposition mismatch (fwd 1024-wide tiles, bwd
+    ``bk``-wide) is invisible.
     """
-    q, k, v, out, lse = res
+    q, k, v, out, lse, seed = res
     BH, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
     f32 = jnp.float32
@@ -295,20 +376,36 @@ def _jnp_blockwise_bwd(causal, bk, res, do):
     ks = k.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)  # (nk, BH, bk, D)
     vs = v.reshape(BH, nk, bk, D).transpose(1, 0, 2, 3)
     rows = jnp.arange(S)
+    threshold = _dropout_threshold(rate)
+    bh_idx = jnp.arange(BH)
 
     def one_block(dq_acc, blk):
         ki, k_b, v_b = blk
+        cols = ki * bk + jnp.arange(bk)
         s = jnp.einsum("bqd,bkd->bqk", q, k_b, preferred_element_type=f32) * scale
         if causal:
-            cols = ki * bk + jnp.arange(bk)
             mask = rows[:, None] >= cols[None, :]
             s = jnp.where(mask[None], s, NEG_INF)
         p = jnp.exp(s - lse[:, :, None])  # (BH, S, bk) fp32
         if causal:
             p = jnp.where(mask[None], p, 0.0)
-        pc = p.astype(cd)
-        dv_b = jnp.einsum("bqk,bqd->bkd", pc, dof, preferred_element_type=f32)
+        if rate > 0.0:
+            keep = _dropout_keep(
+                seed[0], bh_idx[:, None, None], rows[None, :, None],
+                cols[None, None, :], S, threshold,
+            )  # (BH, S, bk)
+            inv = 1.0 / (1.0 - rate)
+            pd = jnp.where(keep, p * inv, 0.0)
+            dp_scale = jnp.where(keep, inv, 0.0)
+        else:
+            pd = p
+            dp_scale = None
+        dv_b = jnp.einsum(
+            "bqk,bqd->bkd", pd.astype(cd), dof, preferred_element_type=f32
+        )
         dp = jnp.einsum("bqd,bkd->bqk", dof, v_b, preferred_element_type=f32)
+        if dp_scale is not None:
+            dp = dp * dp_scale
         ds = (p * (dp - delta[:, :, None]) * scale).astype(cd)
         dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, k_b, preferred_element_type=f32)
         dk_b = jnp.einsum("bqk,bqd->bkd", ds, q, preferred_element_type=f32)
@@ -327,10 +424,11 @@ def _flash_bwd_rule(opts, res, do):
     the default XLA-fused blockwise einsum path (faster on v5e), and the
     hand-written Pallas kernel pair (dq; dk/dv) below.
     """
-    causal, interpret, bq, bk_fwd, bk, pallas_bwd = opts
+    causal, interpret, bq, bk_fwd, bk, pallas_bwd, rate = opts
+    seed_ct = np.zeros((1,), jax.dtypes.float0)  # seed is integral: no tangent
     if not pallas_bwd:
-        return _jnp_blockwise_bwd(causal, bk, res, do)
-    q, k, v, out, lse = res
+        return (*_jnp_blockwise_bwd(causal, bk, rate, res, do), seed_ct)
+    q, k, v, out, lse, seed = res
     BH, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
 
@@ -342,16 +440,20 @@ def _flash_bwd_rule(opts, res, do):
     lse3 = jnp.broadcast_to(lse[:, None, :], (BH, 8, S))
     delta3 = jnp.broadcast_to(delta[:, None, :], (BH, 8, S))
 
+    seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
     row_specs = dict(
         q=pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
         k=pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
         stat=pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, 0, qi)),
     )
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, scale=scale, causal=causal),
+        functools.partial(
+            _bwd_dq_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
+            seq_len=S, dropout_rate=rate,
+        ),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         grid=(BH, S // bq, S // bk),
-        in_specs=[row_specs["q"], row_specs["k"], row_specs["k"],
+        in_specs=[seed_spec, row_specs["q"], row_specs["k"], row_specs["k"],
                   row_specs["q"], row_specs["stat"], row_specs["stat"]],
         out_specs=row_specs["q"],
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
@@ -359,7 +461,7 @@ def _flash_bwd_rule(opts, res, do):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(seed, q, k, v, do, lse3, delta3)
 
     col_specs = dict(
         q=pl.BlockSpec((1, bq, D), lambda b, ki, qi: (b, qi, 0)),
@@ -367,13 +469,16 @@ def _flash_bwd_rule(opts, res, do):
         stat=pl.BlockSpec((1, 8, bq), lambda b, ki, qi: (b, 0, qi)),
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, scale=scale, causal=causal),
+        functools.partial(
+            _bwd_dkv_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
+            seq_len=S, dropout_rate=rate,
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), k.dtype),
             jax.ShapeDtypeStruct((BH, S, D), v.dtype),
         ],
         grid=(BH, S // bk, S // bq),
-        in_specs=[col_specs["q"], col_specs["k"], col_specs["k"],
+        in_specs=[seed_spec, col_specs["q"], col_specs["k"], col_specs["k"],
                   col_specs["q"], col_specs["stat"], col_specs["stat"]],
         out_specs=[col_specs["k"], col_specs["k"]],
         scratch_shapes=[
@@ -384,9 +489,9 @@ def _flash_bwd_rule(opts, res, do):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, do, lse3, delta3)
+    )(seed, q, k, v, do, lse3, delta3)
 
-    return dq, dk, dv
+    return dq, dk, dv, seed_ct
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -396,7 +501,7 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
     jax.jit,
     static_argnames=(
         "causal", "interpret", "block_q", "block_k", "block_k_bwd",
-        "pallas_backward",
+        "pallas_backward", "dropout_rate",
     ),
 )
 def flash_attention(
@@ -409,11 +514,21 @@ def flash_attention(
     block_k: Optional[int] = None,
     block_k_bwd: Optional[int] = None,
     pallas_backward: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Multi-head flash attention over (batch, seq, heads, head_dim) inputs.
 
     Forward and backward take separate K-block sizes because their optima
     differ on v5e (see _FWD_BLOCK_* notes above).
+
+    ``dropout_rate`` > 0 (with a uint32 scalar/1-vector ``dropout_seed``)
+    applies attention-probability dropout INSIDE the kernel — parity with the
+    reference's ``nn.MultiheadAttention(dropout=...)`` (train_harness.py:116)
+    that earlier rounds had to document as a deviation. The keep mask is a
+    stateless hash of absolute coordinates, so fwd/bwd agree despite their
+    different tilings. With ``dropout_seed=None`` the rate is ignored
+    (matching the model's deterministic/no-key dropout convention).
     """
     B, S, H, D = q.shape
     if interpret is None:
@@ -426,14 +541,21 @@ def flash_attention(
             f"block sizes (block_q={bq}, block_k={bk}, block_k_bwd={bk_bwd}) "
             f"must divide seq_len={S}"
         )
+    if dropout_seed is None:
+        dropout_rate = 0.0
+        seed = jnp.zeros((1,), jnp.uint32)
+    else:
+        seed = jnp.asarray(dropout_seed, jnp.uint32).reshape((1,))
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
 
     # (B, S, H, D) -> (B*H, S, D): one grid row per (batch, head) pair.
     def to_bhsd(t):
         return t.transpose(0, 2, 1, 3).reshape(B * H, S, D)
 
     out = _flash(
-        (causal, interpret, bq, bk, bk_bwd, pallas_backward),
-        to_bhsd(q), to_bhsd(k), to_bhsd(v),
+        (causal, interpret, bq, bk, bk_bwd, pallas_backward, dropout_rate),
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), seed,
     )
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 

@@ -152,3 +152,239 @@ def pipeline_loss_fn(
         axis_names=frozenset({AXIS}),
     )
     return fn(params, batch)
+
+
+def pipeline_loss_and_grads_1f1b(
+    config: tinygpt.TinyGPTConfig,
+    mesh: Mesh,
+    params,
+    batch: jax.Array,  # (M, mb, S) microbatches; targets are the inputs
+    base_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+):
+    """1F1B-interleaved pipeline schedule with a hand-scheduled backward.
+
+    Returns ``(loss, grads)`` directly — the backward is NOT generated by
+    ``jax.grad`` over the forward schedule. That distinction is the point:
+    autodiff of the GPipe loop above reverses the whole program, so every
+    ppermute of the backward sits after every ppermute of the forward in
+    program order and all M microbatches' residuals are live at the
+    fwd/bwd boundary — O(M) activation memory per stage. Here each tick
+    interleaves one forward with one backward (the Megatron-LM 1F1B idea,
+    lockstep variant), so a microbatch's residual dies 2*(P-1-s) ticks after
+    its forward: peak liveness is O(P) regardless of M, which is what lets
+    long accumulation chains (M >> P) train without activation OOM.
+
+    Schedule (P stages, M microbatches, T = M + 2(P-1) ticks): at tick t,
+    stage s forwards microbatch ``t - s`` (exactly GPipe) and backwards
+    microbatch ``t - 2(P-1) + s``. The last stage's backward of microbatch i
+    starts the same tick its forward drains (its loss gradient is computed
+    in place); gradients flow stage-to-stage over the reverse ppermute ring,
+    one hop per tick, meeting each stage precisely 2(P-1-s) ticks after it
+    forwarded that microbatch. Both the fill and drain bubbles are 2(P-1)
+    ticks — the same fraction as GPipe; 1F1B's win is memory, not bubble
+    (only *interleaved* virtual stages shrink the bubble).
+
+    Residuals: instead of storing per-microbatch VJP closures (not SPMD-able —
+    the tick a stage needs them at differs per stage), each stage keeps a
+    rolling buffer of its last 2P-1 forward *inputs* and rematerializes the
+    stage forward under ``jax.vjp`` at backward time (per-stage activation
+    recompute, the standard Megatron configuration). Dropout keys are derived
+    from the originating tick index, so the recompute replays the forward
+    bit-for-bit.
+    """
+    n_stages = mesh.shape[AXIS]
+    if config.n_layer % n_stages != 0:
+        raise ValueError(
+            f"n_layer={config.n_layer} not divisible by pipe={n_stages}"
+        )
+    if config.n_experts > 0:
+        raise ValueError(
+            "MoE does not compose with pipeline parallelism in this version "
+            "(per-stage aux-loss accounting); use dp/tp/ep"
+        )
+    layers_per_stage = config.n_layer // n_stages
+    n_micro = batch.shape[0]
+    ticks = n_micro + 2 * (n_stages - 1)
+    depth = 2 * n_stages - 1  # rolling residual-buffer depth
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    inv_m = 1.0 / n_micro
+
+    def staged(params, batch):
+        stage = lax.axis_index(AXIS)
+        is_last = stage == n_stages - 1
+        blocks = params["blocks"]  # local slice: (L/P, ...)
+        mb, S = batch.shape[1], batch.shape[2]
+        D = config.n_embd
+        state = jnp.zeros((mb, S, D), config.compute_dtype)
+        g_recv = jnp.zeros((mb, S, D), config.compute_dtype)
+        buf = jnp.zeros((depth, mb, S, D), config.compute_dtype)
+        loss_sum = jnp.zeros((), jnp.float32)
+
+        d_blocks = jax.tree.map(jnp.zeros_like, blocks)
+        hp = {k: params[k] for k in ("lnf_scale", "lnf_bias", "wte")}
+        ep = {k: params[k] for k in ("wte", "wpe")}
+        d_ep = jax.tree.map(jnp.zeros_like, ep)
+
+        # Head strategy mirrors pipeline_loss_fn: on TPU a lax.cond skips the
+        # layer-scale head fwd+vjp on non-final stages entirely; on CPU (where
+        # XLA's AllReducePromotion pass aborts on cond-lowered collectives)
+        # every stage computes it and dl=0 masks the cotangents. For the cond
+        # path hp is pre-cast to 'varying' so the head vjp stays collective-
+        # free inside the divergent branch (an invariant primal would make the
+        # transpose insert a psum there — deadlock); the one psum that makes
+        # d_hp invariant again runs after the tick loop.
+        head_cond = jax.default_backend() != "cpu"
+        if head_cond:
+            hp_in = jax.tree.map(
+                lambda x: lax.pcast(x, (AXIS,), to="varying"), hp
+            )
+        else:
+            hp_in = hp
+        d_hp = jax.tree.map(jnp.zeros_like, hp_in)
+
+        emb_key = (
+            jax.random.fold_in(base_key, 1_000_003) if base_key is not None else None
+        )
+        offset = stage * layers_per_stage
+        live_keys = base_key is not None and not deterministic
+
+        def stage_fwd(blk, x, key):
+            return tinygpt.apply_blocks(
+                config, blk, x, key, deterministic, layer_offset=offset
+            )[0]
+
+        for t in range(ticks):
+            # ---- forward unit: stage s runs microbatch t - s (as GPipe) ----
+            if t < n_micro:
+                ek = jax.random.fold_in(emb_key, t) if live_keys else None
+                inject = tinygpt.embed(config, params, batch[t], ek, deterministic)
+                state_in = jnp.where(stage == 0, inject, state)
+            else:
+                state_in = state
+            # Circular residual buffer: write slot t % depth (no O(depth)
+            # shift-copy per tick).
+            buf = lax.dynamic_update_index_in_dim(buf, state_in, t % depth, 0)
+            if t < n_micro + n_stages - 1:  # fwd window; later ticks drain only
+                bk = jax.random.fold_in(base_key, t) if live_keys else None
+                state_out = stage_fwd(blocks, state_in, bk)
+            else:
+                state_out = state_in
+
+            # ---- loss + its gradient, in place, on the last stage ----
+            li = t - (n_stages - 1)
+            d_x_head = jnp.zeros_like(state_out)
+            if 0 <= li < n_micro:
+                def head_loss(hp_arg, x):
+                    return tinygpt._cross_entropy(
+                        tinygpt.head(config, hp_arg, x), batch[li]
+                    )
+
+                if head_cond:
+                    def head_work(so=state_out, fn=head_loss):
+                        l, vjp_head = jax.vjp(fn, hp_in, so)
+                        dl = lax.pcast(
+                            jnp.asarray(inv_m, jnp.float32), (AXIS,), to="varying"
+                        )
+                        d_hp_t, d_xh = vjp_head(dl)
+                        return l, d_hp_t, d_xh
+
+                    def head_zero(so=state_out):
+                        var = lambda z: lax.pcast(z, (AXIS,), to="varying")
+                        return (
+                            var(jnp.zeros((), jnp.float32)),
+                            jax.tree.map(lambda x: var(jnp.zeros(x.shape, x.dtype)), hp),
+                            var(jnp.zeros_like(so)),
+                        )
+
+                    l, d_hp_t, d_x_head = lax.cond(is_last, head_work, head_zero)
+                    loss_sum = loss_sum + l
+                else:
+                    # compute-and-mask: dl = 0 on non-final stages zeroes both
+                    # cotangents, so no cross-stage control flow is needed
+                    l, vjp_head = jax.vjp(head_loss, hp_in, state_out)
+                    loss_sum = loss_sum + jnp.where(is_last, l, 0.0)
+                    dl = jnp.where(is_last, inv_m, 0.0)
+                    d_hp_t, d_x_head = vjp_head(dl)
+                d_hp = jax.tree.map(jnp.add, d_hp, d_hp_t)
+
+            # ---- backward unit: stage s runs microbatch t - 2(P-1) + s ----
+            if t >= n_stages - 1:  # before this no stage has backward work
+                bi = t - 2 * (n_stages - 1) + stage
+                vb = (bi >= 0) & (bi < n_micro)
+                g_in = jnp.where(is_last, d_x_head.astype(g_recv.dtype), g_recv)
+                g_in = jnp.where(vb, g_in, jnp.zeros((), g_in.dtype))
+                # Residual: this stage forwarded microbatch bi at tick
+                # t - 2(P-1) + 2s, i.e. 2(P-1-s) writes ago.
+                k_back = jnp.clip(2 * (n_stages - 1) - 2 * stage, 0, depth - 1)
+                x_saved = lax.dynamic_index_in_dim(
+                    buf, jnp.mod(t - k_back, depth), 0, keepdims=False
+                )
+                bk_orig = (
+                    jax.random.fold_in(base_key, t - 2 * (n_stages - 1) + 2 * stage)
+                    if live_keys else None
+                )
+                _, vjp_blk = jax.vjp(
+                    lambda blk, x: stage_fwd(blk, x, bk_orig), blocks, x_saved
+                )
+                d_blk_t, d_x = vjp_blk(g_in)
+                d_blocks = jax.tree.map(jnp.add, d_blocks, d_blk_t)
+
+                # Stage 0's input cotangent belongs to the embedding. Its
+                # backward microbatch index is static (bi at s=0), so the
+                # embed recompute uses a static batch row.
+                bi0 = t - 2 * (n_stages - 1)
+                if 0 <= bi0 < n_micro:
+                    ek0 = jax.random.fold_in(emb_key, bi0) if live_keys else None
+                    # pcast marks the (stage-invariant) embed output as
+                    # varying over 'pipe' so it accepts the varying cotangent;
+                    # pcast's transpose is a psum, so d_ep_t comes back
+                    # already reduced across stages (invariant) — the final
+                    # grads need no further psum for wte/wpe.
+                    _, vjp_emb = jax.vjp(
+                        lambda ep: lax.pcast(
+                            tinygpt.embed(config, ep, batch[bi0], ek0, deterministic),
+                            (AXIS,), to="varying",
+                        ),
+                        ep,
+                    )
+                    (d_ep_t,) = vjp_emb(
+                        jnp.where(stage == 0, d_x, jnp.zeros((), d_x.dtype))
+                    )
+                    d_ep = jax.tree.map(jnp.add, d_ep, d_ep_t)
+
+                if t < ticks - 1:
+                    g_recv = lax.ppermute(d_x, AXIS, perm_bwd)
+
+            if t < n_micro + n_stages - 2:
+                state = lax.ppermute(state_out, AXIS, perm_fwd)
+
+        loss = lax.psum(loss_sum, AXIS) * inv_m
+        if head_cond:
+            # cond path kept d_hp varying (nonzero on the last stage only);
+            # one psum re-replicates it.
+            d_hp = jax.tree.map(lambda x: lax.psum(x, AXIS), d_hp)
+        # Otherwise d_hp is already pipe-invariant: the vjp of using an
+        # invariant primal (hp) in a varying computation transposes the
+        # implicit broadcast into a psum. d_ep likewise came back invariant
+        # through the embed's explicit pcast. No further reduction — it
+        # would double-count.
+        grads = {
+            "blocks": d_blocks,
+            "wte": d_hp["wte"] + d_ep["wte"],
+            "wpe": d_ep["wpe"],
+            "lnf_scale": d_hp["lnf_scale"],
+            "lnf_bias": d_hp["lnf_bias"],
+        }
+        return loss, grads
+
+    specs = pipeline_param_specs(params, mesh)
+    fn = jax.shard_map(
+        staged,
+        mesh=mesh,
+        in_specs=(specs, P()),
+        out_specs=(P(), specs),
+        axis_names=frozenset({AXIS}),
+    )
+    return fn(params, batch)

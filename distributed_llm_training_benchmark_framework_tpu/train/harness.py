@@ -58,7 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--pipeline-parallel", type=int, default=1,
                    help="Pipeline-parallel ('pipe' mesh axis) width; layer "
                         "count must divide evenly; grad-accum microbatches "
-                        "feed the GPipe schedule")
+                        "feed the pipeline schedule")
+    p.add_argument("--pipeline-schedule", choices=["gpipe", "1f1b"],
+                   default="gpipe",
+                   help="Pipeline schedule: 'gpipe' (autodiff fill-drain, "
+                        "O(M) activation liveness) or '1f1b' (hand-scheduled "
+                        "interleaved backward, O(P) liveness for long "
+                        "accumulation chains)")
     p.add_argument("--expert-parallel", type=int, default=1,
                    help="Expert-parallel ('expert' mesh axis) width; needs "
                         "--num-experts divisible by it")
@@ -193,6 +199,7 @@ def main(argv=None) -> int:
             tensor_parallel=args.tensor_parallel,
             sequence_parallel=args.sequence_parallel,
             pipeline_parallel=args.pipeline_parallel,
+            pipeline_schedule=args.pipeline_schedule,
             expert_parallel=args.expert_parallel,
             n_experts=args.num_experts,
             results_dir=args.results_dir,

@@ -46,6 +46,7 @@ def run_benchmark(
     tensor_parallel: int = 1,
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
+    pipeline_schedule: str = "gpipe",
     expert_parallel: int = 1,
     n_experts: int = 0,
     results_dir: Optional[str] = None,
@@ -121,9 +122,9 @@ def run_benchmark(
         raise ValueError("MoE does not compose with pipeline parallelism yet")
     if is_main:
         print(f"Strategy: {strategy.describe()}")
-        if attention_impl != "reference" and model_config.dropout > 0:
+        if attention_impl == "ring" and model_config.dropout > 0:
             print(
-                f"Note: attention_impl={attention_impl!r} does not apply "
+                "Note: attention_impl='ring' does not apply "
                 "attention-probability dropout (embedding/MLP dropout still "
                 "active); use --dropout 0 for exact cross-impl loss parity"
             )
@@ -140,6 +141,7 @@ def run_benchmark(
     state = create_train_state(
         model_config, strategy, mesh, seed=seed, grad_accum=grad_accum,
         from_table=True, global_micro=global_micro, seq_len=seq_len,
+        pipeline_schedule=pipeline_schedule,
     )
     if is_main:
         print(f"Model initialized: {state.n_params/1e6:.2f}M parameters")
@@ -262,6 +264,7 @@ def run_benchmark(
         tensor_parallel=tp,
         sequence_parallel=sp,
         pipeline_parallel=pp,
+        pipeline_schedule=pipeline_schedule,
         expert_parallel=ep,
         n_experts=n_experts,
     )

@@ -89,6 +89,7 @@ def make_train_step(
     from_table: bool = False,
     global_micro: int = 1,
     seq_len: int = 0,
+    pipeline_schedule: str = "gpipe",
 ) -> Callable:
     """Build the jitted train step for one strategy arm.
 
@@ -125,7 +126,16 @@ def make_train_step(
 
     pipelined = mesh.shape.get("pipe", 1) > 1
     if pipelined:
-        from ..parallel.pipeline import pipeline_loss_fn
+        from ..parallel.pipeline import (
+            pipeline_loss_and_grads_1f1b,
+            pipeline_loss_fn,
+        )
+
+        if pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline schedule {pipeline_schedule!r} "
+                "(expected 'gpipe' or '1f1b')"
+            )
 
     def train_step(params, opt_state, batch, step):
         if from_table:
@@ -148,7 +158,15 @@ def make_train_step(
             grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
             return (loss_acc + loss, grad_acc), None
 
-        if pipelined:
+        if pipelined and pipeline_schedule == "1f1b":
+            # Hand-scheduled backward (O(P) residual liveness) — see
+            # parallel.pipeline.pipeline_loss_and_grads_1f1b.
+            loss, grads = pipeline_loss_and_grads_1f1b(
+                cfg, mesh, params, batch,
+                base_key=None if deterministic_dropout else base_key,
+                deterministic=deterministic_dropout,
+            )
+        elif pipelined:
             # The microbatch axis feeds the GPipe schedule directly — the
             # pipeline IS the gradient accumulation.
             loss, grads = jax.value_and_grad(
@@ -221,6 +239,7 @@ def create_train_state(
     from_table: bool = False,
     global_micro: int = 1,
     seq_len: int = 0,
+    pipeline_schedule: str = "gpipe",
 ) -> TrainState:
     """Initialize params + optimizer state directly into their target shardings.
 
@@ -263,6 +282,7 @@ def create_train_state(
         from_table=from_table,
         global_micro=global_micro,
         seq_len=seq_len,
+        pipeline_schedule=pipeline_schedule,
     )
     return TrainState(
         params=params,

@@ -87,6 +87,7 @@ class BenchmarkResult:
     tensor_parallel: int = 1
     sequence_parallel: int = 1
     pipeline_parallel: int = 1
+    pipeline_schedule: str = "gpipe"  # meaningful when pipeline_parallel > 1
     expert_parallel: int = 1
     n_experts: int = 0
 
@@ -121,6 +122,7 @@ def compute_result(
     tensor_parallel: int = 1,
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
+    pipeline_schedule: str = "gpipe",
     expert_parallel: int = 1,
     n_experts: int = 0,
 ) -> BenchmarkResult:
@@ -171,6 +173,7 @@ def compute_result(
         tensor_parallel=tensor_parallel,
         sequence_parallel=sequence_parallel,
         pipeline_parallel=pipeline_parallel,
+        pipeline_schedule=pipeline_schedule,
         expert_parallel=expert_parallel,
         n_experts=n_experts,
     )

@@ -66,6 +66,120 @@ def test_flash_is_differentiable():
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=5e-3, atol=5e-3)
 
 
+def _hash_keep_mask(seed, B, H, S, rate):
+    """Materialize the kernel's keep mask from the same absolute-coordinate
+    hash, as a (B, H, S, S) boolean array."""
+    from distributed_llm_training_benchmark_framework_tpu.ops import flash_attention as fa
+
+    bh = jnp.arange(B * H)[:, None, None]
+    rows = jnp.arange(S)[None, :, None]
+    cols = jnp.arange(S)[None, None, :]
+    keep = fa._dropout_keep(
+        jnp.uint32(seed), bh, rows, cols, S, fa._dropout_threshold(rate)
+    )
+    return keep.reshape(B, H, S, S)
+
+
+def _masked_reference(q, k, v, keep, rate, causal=False):
+    """Materialized attention with an explicit post-softmax dropout mask."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        S = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(keep, p / (1.0 - rate), 0.0)
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(q.dtype), v, preferred_element_type=jnp.float32
+    ).astype(q.dtype)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_dropout_matches_masked_reference(causal):
+    """Forward with in-kernel dropout == materialized attention with the same
+    hash-derived mask applied post-softmax."""
+    rate = 0.25
+    B, S, H, D = 2, 128, 4, 32
+    q, k, v = qkv(B=B, S=S, H=H, D=D)
+    seed = jnp.asarray(1234, jnp.uint32)
+    out = flash_attention(
+        q, k, v, causal=causal, interpret=True, block_q=32, block_k=32,
+        dropout_rate=rate, dropout_seed=seed,
+    )
+    keep = _hash_keep_mask(1234, B, H, S, rate)
+    ref = _masked_reference(q, k, v, keep, rate, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_flash_dropout_block_size_invariant():
+    """The keep mask is a function of absolute coordinates, so different
+    tilings (the fwd/bwd situation) produce the same output."""
+    rate = 0.1
+    q, k, v = qkv(B=1, S=128, H=2, D=32)
+    seed = jnp.asarray(7, jnp.uint32)
+    kw = dict(interpret=True, dropout_rate=rate, dropout_seed=seed)
+    out32 = flash_attention(q, k, v, block_q=32, block_k=32, **kw)
+    out64 = flash_attention(q, k, v, block_q=64, block_k=64, **kw)
+    # Not bitwise: online-softmax accumulation order differs per tiling. But a
+    # single flipped mask element would shift entries by O(p*v/keep) >> 1e-5.
+    np.testing.assert_allclose(
+        np.asarray(out32), np.asarray(out64), rtol=1e-5, atol=1e-5
+    )
+    # And both agree with the materialized-mask reference.
+    keep = _hash_keep_mask(7, 1, 2, 128, rate)
+    ref = _masked_reference(q, k, v, keep, rate)
+    np.testing.assert_allclose(np.asarray(out64), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("pallas_backward", [False, True])
+def test_flash_dropout_grad_matches_masked_reference(pallas_backward):
+    """Backward (both the jnp blockwise path and the Pallas kernel pair)
+    regenerates the identical mask, at a different block size than the
+    forward ran with."""
+    rate = 0.2
+    B, S, H, D = 1, 64, 2, 16
+    q, k, v = qkv(B=B, S=S, H=H, D=D)
+    seed = jnp.asarray(99, jnp.uint32)
+    keep = _hash_keep_mask(99, B, H, S, rate)
+
+    def loss_flash(q, k, v):
+        return flash_attention(
+            q, k, v, interpret=True, block_q=32, block_k=32, block_k_bwd=16,
+            dropout_rate=rate, dropout_seed=seed,
+            pallas_backward=pallas_backward,
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return _masked_reference(q, k, v, keep, rate).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3)
+
+
+def test_flash_dropout_keep_statistics():
+    """Empirical keep fraction tracks 1 - rate (hash uniformity sanity)."""
+    from distributed_llm_training_benchmark_framework_tpu.ops import flash_attention as fa
+
+    rate = 0.3
+    keep = _hash_keep_mask(42, 2, 4, 128, rate)
+    frac = float(jnp.mean(keep.astype(jnp.float32)))
+    assert abs(frac - 0.7) < 0.01, frac
+    # Different seeds decorrelate.
+    keep2 = _hash_keep_mask(43, 2, 4, 128, rate)
+    assert bool(jnp.any(keep != keep2))
+
+
+def test_flash_dropout_none_seed_is_deterministic():
+    q, k, v = qkv(B=1, S=64, H=2, D=16)
+    out = flash_attention(
+        q, k, v, interpret=True, dropout_rate=0.5, dropout_seed=None
+    )
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_matches_reference(causal, eight_devices):
     mesh = make_mesh((4,), ("seq",), devices=eight_devices[:4])

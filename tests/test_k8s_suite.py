@@ -59,6 +59,9 @@ if "get" in argv and "pod" in argv:
 if "logs" in argv:
     pod = argv[-1]
     m = re.match(r"tpu-bench-(\w+)-ws(\d+)", pod)
+    if m is None:
+        # e.g. the failure-diagnostic call `kubectl logs -l job-name=... --tail=100`
+        sys.exit(0)
     strategy, ws = m.group(1), int(m.group(2))
     result = {
         "strategy": strategy, "world_size": ws, "rank": 0, "seq_len": 128,
