@@ -34,9 +34,9 @@ def main():
     p.add_argument("--world-size", type=int, default=None,
                    help="default: all visible devices")
     # flash is the headline config: same model/loss/optimizer/data as the
-    # parity setup; the Pallas kernel omits only attention-probability dropout
-    # (documented deviation — the probabilities never materialize). Pass
-    # --attention reference for the exact-reference-semantics run.
+    # parity setup, including in-kernel attention-probability dropout (the
+    # probabilities still never materialize in HBM). Pass
+    # --attention reference for the materialized-softmax run.
     p.add_argument("--attention", default="flash",
                    choices=["reference", "flash", "ring"])
     p.add_argument("--dropout", type=float, default=None)

@@ -55,11 +55,26 @@ def fmt_table(df: pd.DataFrame, cols: List[str]) -> str:
     return "\n".join([header, sep] + rows)
 
 
-def build_report(df: pd.DataFrame, plots_dir: str = "../plots") -> str:
+def build_report(
+    df: pd.DataFrame, plots_dir: str = "../plots", plots_root: str = ""
+) -> str:
     cols = [
         "strategy", "world_size", "seq_len", "tokens_per_sec",
         "mean_step_time_sec", "peak_vram_gb", "scaling_efficiency_pct",
     ]
+    # TPU-additive columns, surfaced when the data carries them: attention
+    # impl (reference vs flash rows share a table) and MFU.
+    if "attention_impl" in df.columns and df["attention_impl"].nunique() > 1:
+        cols.insert(3, "attention_impl")
+    if "mfu_pct" in df.columns and (df["mfu_pct"] > 0).any():
+        cols.insert(cols.index("mean_step_time_sec") + 1, "mfu_pct")
+    if "est_hbm_gb" in df.columns and (
+        "peak_vram_gb" not in df.columns or (df["peak_vram_gb"] == 0).all()
+    ):
+        # Measurement unavailable on this platform; show the pre-flight
+        # estimate instead of an all-zero measured column.
+        cols = [c for c in cols if c != "peak_vram_gb"]
+        cols.insert(-1, "est_hbm_gb")
     cols = [c for c in cols if c in df.columns]
     out = ["# TPU Distributed Training Benchmark Report", ""]
 
@@ -97,6 +112,17 @@ def build_report(df: pd.DataFrame, plots_dir: str = "../plots") -> str:
             f"- **Lowest peak HBM:** {low_mem['strategy']} at "
             f"{low_mem['peak_vram_gb']:.2f} GB/chip"
         )
+    if "mfu_pct" in df.columns and (df["mfu_pct"] > 0).any():
+        best_mfu = df.loc[df["mfu_pct"].idxmax()]
+        impl = (
+            f", {best_mfu['attention_impl']} attention"
+            if "attention_impl" in df.columns else ""
+        )
+        out.append(
+            f"- **Best MFU:** {best_mfu['strategy']} at "
+            f"{best_mfu['mfu_pct']:.1f}% of bf16 peak"
+            f" (seq {int(best_mfu['seq_len'])}{impl})"
+        )
     out.append("")
 
     out += ["## Plots", ""]
@@ -106,7 +132,15 @@ def build_report(df: pd.DataFrame, plots_dir: str = "../plots") -> str:
         ("scaling_efficiency.png", "Scaling efficiency vs chip count"),
         ("vram_vs_seqlen.png", "Peak HBM vs sequence length"),
         ("gbps_vs_gpu.png", "H2D transfer proxy"),
+        ("tokens_per_sec_by_strategy.png",
+         "Throughput by strategy and attention impl"),
+        ("mfu_by_strategy.png", "MFU by strategy"),
+        ("tokens_vs_seqlen.png", "Throughput vs sequence length"),
     ]:
+        # Skip links to figures the plotter didn't render for this dataset
+        # (when we can see the plots directory; embed unconditionally if not).
+        if plots_root and not os.path.exists(os.path.join(plots_root, name)):
+            continue
         out.append(f"![{caption}]({plots_dir}/{name})")
     out.append("")
     return "\n".join(out)
@@ -121,8 +155,9 @@ def main(argv=None) -> int:
     df = pd.read_csv(args.csv)
     os.makedirs(args.out, exist_ok=True)
     path = os.path.join(args.out, "BENCHMARK_REPORT.md")
+    plots_root = os.path.normpath(os.path.join(args.out, args.plots_dir))
     with open(path, "w") as f:
-        f.write(build_report(df, args.plots_dir))
+        f.write(build_report(df, args.plots_dir, plots_root=plots_root))
     print(f"Wrote {path}")
     return 0
 

@@ -54,6 +54,16 @@ def _color_for(strategy: str, i: int) -> str:
     return STRATEGY_COLORS.get(strategy, FALLBACK_COLORS[i % len(FALLBACK_COLORS)])
 
 
+def _seq_key_cols(df: pd.DataFrame) -> List[str]:
+    """Line-grouping key for the vs-sequence-length figures: a mixed results
+    dir holds several rows per (strategy, seq_len) — one per attention impl /
+    world size — and merging them into one line would draw vertical zigzags."""
+    return ["strategy"] + [
+        c for c in ("attention_impl", "world_size")
+        if c in df.columns and df[c].nunique() > 1
+    ]
+
+
 def _line_per_strategy(df: pd.DataFrame, x: str, y: str, ax) -> None:
     for i, (strategy, g) in enumerate(sorted(df.groupby("strategy"))):
         g = g.sort_values(x)
@@ -90,16 +100,24 @@ def make_plots(df: pd.DataFrame, out_dir: str) -> List[str]:
     _save(fig, out_dir, "step_time_vs_gpu.png", written)
 
     if df["seq_len"].nunique() > 1:
+        # Measured peak when the platform reports allocator stats; the
+        # pre-flight analytic estimate otherwise (all-zero measured column).
+        mem_col, mem_label = "peak_vram_gb", "Peak HBM (GB)"
+        if df["peak_vram_gb"].max() == 0 and "est_hbm_gb" in df.columns:
+            mem_col, mem_label = "est_hbm_gb", "Estimated HBM (GiB)"
         fig, ax = plt.subplots(figsize=(7, 4.5))
-        for i, (strategy, g) in enumerate(sorted(df.groupby("strategy"))):
+        for i, (key, g) in enumerate(sorted(df.groupby(_seq_key_cols(df)))):
+            key = key if isinstance(key, tuple) else (key,)
             g = g.sort_values("seq_len")
             ax.plot(
-                g["seq_len"], g["peak_vram_gb"],
-                label=strategy, color=_color_for(strategy, i),
+                g["seq_len"], g[mem_col],
+                label=" ".join(str(k) for k in key),
+                color=_color_for(key[0], i),
+                linestyle="--" if "reference" in key else "-",
                 linewidth=2, marker="o", markersize=6,
             )
-        ax.legend(frameon=False, labelcolor=TEXT)
-        _style_axes(ax, "Sequence length", "Peak HBM (GB)", "Peak memory vs sequence length")
+        ax.legend(frameon=False, labelcolor=TEXT, fontsize=8)
+        _style_axes(ax, "Sequence length", mem_label, "Memory vs sequence length")
         _save(fig, out_dir, "vram_vs_seqlen.png", written)
 
     fig, ax = plt.subplots(figsize=(7, 4.5))
@@ -115,6 +133,98 @@ def make_plots(df: pd.DataFrame, out_dir: str) -> List[str]:
     _line_per_strategy(df, "world_size", "h2d_gbps_per_gpu", ax)
     _style_axes(ax, "Chips", "H2D GB/s per chip (proxy)", "Host-to-device transfer proxy")
     _save(fig, out_dir, "gbps_vs_gpu.png", written)
+
+    # --- Beyond-reference figures (rendered when the data supports them) ---
+
+    # Per-strategy throughput bars, grouped by attention impl: the natural
+    # view for a single-chip (world_size-degenerate) suite.
+    impls = (
+        sorted(df["attention_impl"].dropna().unique())
+        if "attention_impl" in df.columns else []
+    )
+    base_seq = df["seq_len"].min()
+    base = df[df["seq_len"] == base_seq]
+    if impls:
+        strategies = sorted(base["strategy"].unique())
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        width = 0.8 / max(len(impls), 1)
+        hatches = {impl: h for impl, h in zip(impls, ["", "//", "..", "xx"])}
+        for i, strategy in enumerate(strategies):
+            for j, impl in enumerate(impls):
+                rows = base[(base["strategy"] == strategy)
+                            & (base["attention_impl"] == impl)]
+                if rows.empty:
+                    continue
+                val = rows["tokens_per_sec"].max()
+                ax.bar(
+                    i + (j - (len(impls) - 1) / 2) * width, val, width * 0.92,
+                    color=_color_for(strategy, i), hatch=hatches.get(impl, ""),
+                    edgecolor=SURFACE, linewidth=0.5,
+                )
+                ax.text(
+                    i + (j - (len(impls) - 1) / 2) * width, val, impl,
+                    ha="center", va="bottom", fontsize=8, color=TEXT_2,
+                    rotation=0,
+                )
+        ax.set_xticks(range(len(strategies)))
+        ax.set_xticklabels(strategies)
+        _style_axes(
+            ax, "Strategy", "Tokens/sec",
+            f"Throughput by strategy and attention impl (seq {base_seq})",
+        )
+        ax.grid(axis="x", visible=False)
+        _save(fig, out_dir, "tokens_per_sec_by_strategy.png", written)
+
+    # MFU bars — the metric the reference never measured.
+    if "mfu_pct" in df.columns and (base["mfu_pct"] > 0).any():
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        rows = (
+            base[base["mfu_pct"] > 0]
+            .sort_values("mfu_pct", ascending=False)
+            .drop_duplicates(subset=[c for c in ("strategy", "attention_impl")
+                                     if c in base.columns])
+        )
+        labels = [
+            f"{r.strategy}\n({getattr(r, 'attention_impl', '')})"
+            for r in rows.itertuples()
+        ]
+        ax.bar(
+            range(len(rows)), rows["mfu_pct"],
+            color=[_color_for(s, i) for i, s in enumerate(rows["strategy"])],
+            edgecolor=SURFACE, linewidth=0.5,
+        )
+        ax.set_xticks(range(len(rows)))
+        ax.set_xticklabels(labels, fontsize=8)
+        _style_axes(
+            ax, "Strategy (attention)", "Model FLOPs utilization (%)",
+            f"MFU by strategy (seq {base_seq})",
+        )
+        ax.grid(axis="x", visible=False)
+        _save(fig, out_dir, "mfu_by_strategy.png", written)
+
+    # Long-context throughput: tokens/sec vs sequence length. One line per
+    # (strategy, attention impl, world size) — a mixed results dir holds
+    # several rows per (strategy, seq_len) and merging them into one line
+    # would draw meaningless vertical zigzags.
+    if df["seq_len"].nunique() > 1:
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        for i, (key, g) in enumerate(sorted(df.groupby(_seq_key_cols(df)))):
+            key = key if isinstance(key, tuple) else (key,)
+            g = g.sort_values("seq_len")
+            ax.plot(
+                g["seq_len"], g["tokens_per_sec"],
+                label=" ".join(str(k) for k in key),
+                color=_color_for(key[0], i),
+                linestyle="--" if "reference" in key else "-",
+                linewidth=2, marker="o", markersize=6,
+            )
+        ax.set_xscale("log", base=2)
+        ax.legend(frameon=False, labelcolor=TEXT, fontsize=8)
+        _style_axes(
+            ax, "Sequence length", "Tokens/sec",
+            "Throughput vs sequence length",
+        )
+        _save(fig, out_dir, "tokens_vs_seqlen.png", written)
 
     return written
 

@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Pipeline-parallel ('pipe' mesh axis) width; layer "
                         "count must divide evenly; grad-accum microbatches "
                         "feed the pipeline schedule")
+    p.add_argument("--skip-memory-check", action="store_true",
+                   help="Attempt the run even when the pre-flight HBM "
+                        "estimate says it will not fit on this device")
     p.add_argument("--pipeline-schedule", choices=["gpipe", "1f1b"],
                    default="gpipe",
                    help="Pipeline schedule: 'gpipe' (autodiff fill-drain, "
@@ -200,6 +203,7 @@ def main(argv=None) -> int:
             sequence_parallel=args.sequence_parallel,
             pipeline_parallel=args.pipeline_parallel,
             pipeline_schedule=args.pipeline_schedule,
+            skip_memory_check=args.skip_memory_check,
             expert_parallel=args.expert_parallel,
             n_experts=args.num_experts,
             results_dir=args.results_dir,

@@ -59,6 +59,7 @@ def run_benchmark(
     dataset_size: int = 1000,
     log_every: int = 10,
     sync_every: int = 1,
+    skip_memory_check: bool = False,
     profile_dir: Optional[str] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 0,
@@ -99,12 +100,22 @@ def run_benchmark(
     if pp > 1 and tp > 1 and jax.default_backend() == "cpu":
         # XLA's CPU-only AllReducePromotion pass aborts the process compiling
         # the partially-manual pipeline with tensor-parallel collectives
-        # inside ("Invalid binary instruction opcode copy"). TPU compiles
-        # this composition; CPU cannot until the upstream bug is fixed.
-        raise ValueError(
-            "pipeline_parallel x tensor_parallel is not supported on the CPU "
-            "backend (XLA CPU compiler bug); run this composition on TPU"
-        )
+        # inside ("Invalid binary instruction opcode copy"). Workaround:
+        # XLA_FLAGS=--xla_disable_hlo_passes=all-reduce-promotion compiles and
+        # runs tp x pp correctly on CPU (verified vs the ddp trajectory) —
+        # but the dp>1 x tp x pp triple still dies deeper in the SPMD
+        # partitioner (gather partitioning CHECK), so that stays guarded.
+        # TPU compiles all of these compositions.
+        import os as _os
+
+        workaround = "all-reduce-promotion" in _os.environ.get("XLA_FLAGS", "")
+        if not (workaround and dp == 1):
+            raise ValueError(
+                "pipeline_parallel x tensor_parallel on the CPU backend needs "
+                "XLA_FLAGS=--xla_disable_hlo_passes=all-reduce-promotion (XLA "
+                "CPU compiler bug), and dp must be 1 even then; run this "
+                "composition on TPU"
+            )
 
     overrides = {} if dropout is None else {"dropout": dropout}
     if n_experts > 0:
@@ -136,6 +147,27 @@ def run_benchmark(
     # replicas of each example (matching how the reference's world_size
     # multiplies per-device batch for pure DP, reference train_harness.py:403).
     global_micro = per_device_batch * dp
+
+    # Fail fast on arms that cannot fit (e.g. tier B replicated on a 16 GiB
+    # v5e chip) — refuse with a breakdown instead of an allocator OOM mid-run.
+    from ..utils import memory as memory_mod
+    from .step import _resolve_model_config
+
+    est = memory_mod.estimate_hbm(
+        _resolve_model_config(model_config, strategy, mesh), strategy, mesh,
+        per_device_batch, seq_len, dataset_size=dataset_size,
+    )
+    if is_main:
+        print(memory_mod.format_breakdown(est, devices[0].device_kind))
+    refusal = memory_mod.check_fits(est, devices[0].device_kind)
+    if refusal is not None:
+        if skip_memory_check:
+            if is_main:
+                print(f"WARNING (--skip-memory-check): {refusal}")
+        else:
+            raise ValueError(
+                f"{refusal}\nPass --skip-memory-check to attempt the run anyway."
+            )
 
     t_init = time.perf_counter()
     state = create_train_state(
@@ -261,6 +293,7 @@ def run_benchmark(
         attention_impl=attention_impl,
         dropout=model_config.dropout,
         flops_per_token=flops_mod.train_flops_per_token(model_config),
+        est_hbm_gb=round(est.total / 1024**3, 3),
         tensor_parallel=tp,
         sequence_parallel=sp,
         pipeline_parallel=pp,

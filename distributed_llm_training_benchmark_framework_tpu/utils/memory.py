@@ -1,0 +1,216 @@
+"""Per-chip HBM footprint estimation — fail fast instead of OOM-ing.
+
+The reference framework has no memory model at all: requesting its 1.68B
+"stress tier" on hardware that cannot hold it dies in the allocator mid-run
+(its own suite never ran tier B — reference ``scripts/run_all_benchmarks.sh``
+keeps those lines commented out). Here the harness estimates the per-chip
+footprint *before* initializing anything, prints the breakdown, and refuses
+with an explanation when the estimate exceeds device capacity.
+
+Method:
+
+- **Parameter-shaped state is exact**: ``jax.eval_shape`` over ``init_params``
+  and ``optimizer.init`` gives the true byte counts; each leaf is divided by
+  the product of mesh-axis sizes its PartitionSpec shards over (the same
+  specs the train step jits with), so DDP/FSDP/ZeRO/TP/PP layouts all read
+  their real per-chip share. Gradients mirror params (fp32 accumulators),
+  sharded when the strategy reduce-scatters them (ZeRO-2/3, FSDP).
+- **Activations are analytic** (intentionally a model, not a measurement —
+  the point is to predict before allocating): per-layer live tensors for the
+  fwd+bwd of one microbatch, ``~14 * B * S * D`` compute-dtype bytes dense,
+  plus the O(S^2) score/prob tensors ONLY for the materialized 'reference'
+  attention (flash/ring never materialize them — their activation term is
+  what makes long-context tier-A runs fit), plus the fp32 logits + cotangent
+  at the head. Remat collapses the per-layer term to the boundary residual
+  plus one layer's recompute peak.
+
+Scope: single-host estimates for the dp/tp/pp axes the benchmark arms use.
+Numbers are estimates (XLA fusion, padding and collective buffers move the
+real peak ±20%); the capacity check applies a safety margin accordingly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+# Per-chip HBM capacity in GiB, matched by substring against
+# Device.device_kind (same convention as flops._PEAK_TFLOPS_BF16).
+_HBM_GIB = (
+    ("TPU v6 lite", 32.0),
+    ("TPU v6", 32.0),
+    ("TPU v5 lite", 16.0),
+    ("TPU v5e", 16.0),
+    ("TPU v5p", 95.0),
+    ("TPU v5", 95.0),
+    ("TPU v4 lite", 8.0),
+    ("TPU v4", 32.0),
+    ("TPU v3", 16.0),
+    ("TPU v2", 8.0),
+)
+
+
+def device_hbm_bytes(device_kind: str) -> Optional[int]:
+    """Per-chip HBM capacity for a device kind, or None if unknown (CPU)."""
+    for name, gib in _HBM_GIB:
+        if name.lower() in device_kind.lower():
+            return int(gib * 1024**3)
+    return None
+
+
+def _sharded_bytes(shapes, specs, mesh) -> int:
+    """Total bytes of a shape-tree, each leaf divided by its shard factor."""
+    total = 0
+    for shape_leaf, spec_leaf in zip(
+        jax.tree_util.tree_leaves(shapes),
+        jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+        ),
+    ):
+        nbytes = int(np.prod(shape_leaf.shape) or 1) * shape_leaf.dtype.itemsize
+        factor = 1
+        if isinstance(spec_leaf, jax.sharding.PartitionSpec):
+            for entry in spec_leaf:
+                for ax in (entry,) if isinstance(entry, str) else (entry or ()):
+                    factor *= mesh.shape.get(ax, 1)
+        total += nbytes // max(factor, 1)
+    return total
+
+
+@dataclasses.dataclass
+class HBMEstimate:
+    params: int
+    grads: int
+    opt_state: int
+    activations: int
+    logits: int
+    dataset: int
+
+    @property
+    def total(self) -> int:
+        return (
+            self.params + self.grads + self.opt_state
+            + self.activations + self.logits + self.dataset
+        )
+
+    def breakdown(self) -> Dict[str, float]:
+        gib = 1024**3
+        return {
+            "params_gib": self.params / gib,
+            "grads_gib": self.grads / gib,
+            "opt_state_gib": self.opt_state / gib,
+            "activations_gib": self.activations / gib,
+            "logits_gib": self.logits / gib,
+            "dataset_gib": self.dataset / gib,
+            "total_gib": self.total / gib,
+        }
+
+
+def estimate_hbm(
+    model_config: Any,
+    strategy: Any,
+    mesh: Any,
+    per_device_batch: int,
+    seq_len: int,
+    dataset_size: int = 0,
+) -> HBMEstimate:
+    """Estimate the per-chip HBM footprint of one training arm."""
+    from ..models import tinygpt
+    from ..parallel import strategies as strat
+
+    cfg = model_config
+    params_shape = jax.eval_shape(
+        functools.partial(tinygpt.init_params, cfg), jax.random.key(0)
+    )
+    param_specs = strat.param_partition_specs(
+        params_shape, mesh, shard=strategy.shard_params
+    )
+    grad_specs = strat.param_partition_specs(
+        params_shape, mesh, shard=strategy.shard_params or strategy.shard_grads
+    )
+    optimizer = strat.make_optimizer(strategy)
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    opt_specs = strat.opt_state_partition_specs(
+        optimizer, params_shape, param_specs, mesh, shard=strategy.shard_opt_state
+    )
+
+    params_b = _sharded_bytes(params_shape, param_specs, mesh)
+    grads_b = _sharded_bytes(params_shape, grad_specs, mesh)
+    opt_b = _sharded_bytes(opt_shape, opt_specs, mesh)
+
+    # --- analytic activations for one microbatch's fwd+bwd on this chip ---
+    B = per_device_batch  # per-data-parallel-shard batch
+    S, D, L, H, V = seq_len, cfg.n_embd, cfg.n_layer, cfg.n_head, cfg.vocab_size
+    tp = mesh.shape.get("model", 1)
+    pp = mesh.shape.get("pipe", 1)
+    cbytes = jnp_itemsize(cfg.compute_dtype)
+    dense_per_layer = 14 * B * S * D * cbytes  # ln/qkv/attn-out/mlp residuals
+    # Megatron TP shards the head and MLP activations.
+    dense_per_layer = dense_per_layer // max(tp, 1)
+    if cfg.attention_impl == "reference":
+        # scores + probs materialize per head, fp32 softmax: the O(S^2) term.
+        dense_per_layer += 2 * B * (H // max(tp, 1)) * S * S * 4
+    layers_here = L // max(pp, 1)
+    if cfg.remat:
+        act_b = layers_here * 2 * B * S * D * cbytes + dense_per_layer
+    else:
+        act_b = layers_here * dense_per_layer
+    # fp32 logits + cotangent at the LM head.
+    logits_b = 2 * B * S * V * 4
+
+    dataset_b = dataset_size * seq_len * 4  # device-resident int32 table
+
+    return HBMEstimate(
+        params=params_b, grads=grads_b, opt_state=opt_b,
+        activations=act_b, logits=logits_b, dataset=dataset_b,
+    )
+
+
+def jnp_itemsize(dtype: Any) -> int:
+    return int(np.dtype(jax.numpy.dtype(dtype)).itemsize)
+
+
+def format_breakdown(est: HBMEstimate, device_kind: str) -> str:
+    b = est.breakdown()
+    cap = device_hbm_bytes(device_kind)
+    lines = [
+        "Estimated per-chip HBM footprint:",
+        f"  params:      {b['params_gib']:7.2f} GiB",
+        f"  grads:       {b['grads_gib']:7.2f} GiB",
+        f"  opt state:   {b['opt_state_gib']:7.2f} GiB",
+        f"  activations: {b['activations_gib']:7.2f} GiB (analytic)",
+        f"  logits:      {b['logits_gib']:7.2f} GiB",
+        f"  dataset:     {b['dataset_gib']:7.2f} GiB",
+        f"  total:       {b['total_gib']:7.2f} GiB"
+        + (f" / {cap / 1024**3:.0f} GiB {device_kind}" if cap else ""),
+    ]
+    return "\n".join(lines)
+
+
+def check_fits(
+    est: HBMEstimate, device_kind: str, margin: float = 0.95
+) -> Optional[str]:
+    """Return a refusal message if the estimate exceeds usable capacity.
+
+    ``margin`` reserves headroom for XLA scratch/fragmentation. Unknown
+    device kinds (CPU hosts) are never refused.
+    """
+    cap = device_hbm_bytes(device_kind)
+    if cap is None or est.total <= cap * margin:
+        return None
+    b = est.breakdown()
+    hints = []
+    if b["opt_state_gib"] + b["grads_gib"] > 0.4 * b["total_gib"]:
+        hints.append("a sharded arm (fsdp/zero3) or more chips")
+    if b["activations_gib"] > 0.3 * b["total_gib"]:
+        hints.append("--remat, a smaller --per-device-batch, or --attention flash")
+    hint = f" Try {' and '.join(hints)}." if hints else ""
+    return (
+        f"Estimated footprint {b['total_gib']:.1f} GiB exceeds "
+        f"{cap / 1024**3:.0f} GiB on {device_kind} "
+        f"(margin {margin:.0%}).{hint}\n{format_breakdown(est, device_kind)}"
+    )

@@ -74,6 +74,9 @@ class BenchmarkResult:
     h2d_gbps_per_gpu: float
     # --- additive TPU-native fields (ignored by reference-era consumers) ---
     peak_hbm_gb: float = 0.0
+    # Pre-flight analytic estimate (utils.memory) — the published number when
+    # the platform exposes no allocator stats (peak_hbm_gb stays 0 there).
+    est_hbm_gb: float = 0.0
     device_kind: str = ""
     backend: str = ""
     n_params: int = 0
@@ -119,6 +122,7 @@ def compute_result(
     attention_impl: str = "reference",
     dropout: float = 0.0,
     flops_per_token: float = 0.0,
+    est_hbm_gb: float = 0.0,
     tensor_parallel: int = 1,
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
@@ -162,6 +166,7 @@ def compute_result(
         peak_vram_gb=peak_gb,
         h2d_gbps_per_gpu=h2d,
         peak_hbm_gb=peak_gb,
+        est_hbm_gb=est_hbm_gb,
         device_kind=device_kind,
         backend=backend,
         n_params=n_params,

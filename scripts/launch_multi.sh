@@ -15,6 +15,7 @@ TIER="A"
 STEPS=100
 PER_DEVICE_BATCH=1
 GRAD_ACCUM=4
+ATTENTION="reference"
 IMAGE="tpu-llm-bench:latest"
 TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
@@ -31,6 +32,7 @@ while [ $# -gt 0 ]; do
     --steps) STEPS="$2"; shift 2 ;;
     --per-device-batch) PER_DEVICE_BATCH="$2"; shift 2 ;;
     --grad-accum) GRAD_ACCUM="$2"; shift 2 ;;
+    --attention) ATTENTION="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
     --job-name) JOB_NAME="$2"; shift 2 ;;
@@ -61,6 +63,7 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{STEPS}}|$STEPS|g" \
     -e "s|{{PER_DEVICE_BATCH}}|$PER_DEVICE_BATCH|g" \
     -e "s|{{GRAD_ACCUM}}|$GRAD_ACCUM|g" \
+    -e "s|{{ATTENTION}}|$ATTENTION|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \
     -e "s|{{TPU_ACCELERATOR}}|$TPU_ACCELERATOR|g" \
     -e "s|{{TPU_TOPOLOGY}}|$TPU_TOPOLOGY|g" \

@@ -22,7 +22,17 @@ STEPS="${STEPS:-100}"
 WARMUP_STEPS="${WARMUP_STEPS:-5}"
 PER_DEVICE_BATCH="${PER_DEVICE_BATCH:-1}"
 GRAD_ACCUM="${GRAD_ACCUM:-4}"
+# Hard-sync (block on the loss) every N steps. Totals are identical — steps
+# are device-sequential — but syncing each step puts host->device RPC latency
+# inside every timed step, which swamps real step time when the chip sits
+# behind a network tunnel. 10 matches bench.py's timing discipline.
+SYNC_EVERY="${SYNC_EVERY:-10}"
 STRATEGIES="${STRATEGIES:-ddp fsdp zero2 zero3}"
+# Attention implementation per run: 'reference' (exact reference semantics)
+# or 'flash' (Pallas TPU kernel). Suites for both impls can share one
+# RESULTS_DIR — run names (and so result dirs) carry a -flash suffix, and the
+# final analysis pass aggregates whatever has accumulated.
+ATTENTION="${ATTENTION:-reference}"
 WORLD_SIZES="${WORLD_SIZES:-}"
 NAMESPACE="${NAMESPACE:-bench}"
 IMAGE="${IMAGE:-}"
@@ -31,6 +41,7 @@ TIMEOUT_PER_RUN="${TIMEOUT_PER_RUN:-1800}"
 while [ $# -gt 0 ]; do
   case "$1" in
     --k8s) MODE="k8s"; shift ;;
+    --attention) ATTENTION="$2"; shift 2 ;;
     --tier) TIER="$2"; shift 2 ;;
     --seq-len) SEQ_LEN="$2"; shift 2 ;;
     --steps) STEPS="$2"; shift 2 ;;
@@ -56,7 +67,7 @@ import jax; print(jax.device_count())" 2>/dev/null || echo 1)
 fi
 
 echo "=== TPU Benchmark Suite ==="
-echo "mode=$MODE strategies=[$STRATEGIES] world_sizes=[$WORLD_SIZES]"
+echo "mode=$MODE strategies=[$STRATEGIES] world_sizes=[$WORLD_SIZES] attention=$ATTENTION"
 echo "tier=$TIER seq=$SEQ_LEN steps=$STEPS batch=$PER_DEVICE_BATCH accum=$GRAD_ACCUM"
 echo ""
 
@@ -66,14 +77,16 @@ SUITE_START=$(date +%s)
 run_local() {
   local strategy="$1" ws="$2"
   local name="bench-${strategy}-ws${ws}-seq${SEQ_LEN}"
+  [ "$ATTENTION" != "reference" ] && name="${name}-${ATTENTION}"
   local log="$RESULTS_DIR/${name}.log"
   echo "--- $name ---"
   local t0=$(date +%s)
   if timeout "$TIMEOUT_PER_RUN" python -u benchmarking/train_harness.py \
       --strategy "$strategy" --world-size "$ws" --rank 0 \
-      --tier "$TIER" --seq-len "$SEQ_LEN" \
+      --tier "$TIER" --seq-len "$SEQ_LEN" --attention "$ATTENTION" \
       --steps "$STEPS" --warmup-steps "$WARMUP_STEPS" \
       --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
+      --sync-every "$SYNC_EVERY" \
       --results-dir "$RESULTS_DIR/${name}_results" \
       > "$log" 2>&1; then
     scripts/collect_results.sh --log "$log" "$RESULTS_DIR/${name}_results" \
@@ -98,7 +111,7 @@ run_k8s() {
   scripts/launch_multi.sh --strategy "$strategy" --world-size "$ws" \
     --seq-len "$SEQ_LEN" --tier "$TIER" --steps "$STEPS" \
     --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
-    --job-name "$job" \
+    --attention "$ATTENTION" --job-name "$job" \
     ${IMAGE:+--image "$IMAGE"}
   if kubectl -n "$NAMESPACE" wait --for=condition=complete \
        "job/$job" --timeout=900s; then

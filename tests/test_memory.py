@@ -1,0 +1,84 @@
+"""HBM footprint estimator (utils.memory): exact param accounting, sharding
+divisors, and the tier-B refusal the round-1 verdict asked for."""
+
+import jax
+import numpy as np
+
+from distributed_llm_training_benchmark_framework_tpu.models import (
+    get_model_config,
+    init_params,
+    count_params,
+)
+from distributed_llm_training_benchmark_framework_tpu.parallel import (
+    make_mesh,
+    get_strategy,
+)
+from distributed_llm_training_benchmark_framework_tpu.utils import memory as mem
+
+
+def _mesh(dp=1):
+    return make_mesh((dp,), ("data",), devices=jax.devices()[:dp])
+
+
+def test_param_bytes_exact():
+    cfg = get_model_config("S", 64)
+    est = mem.estimate_hbm(cfg, get_strategy("ddp"), _mesh(), 1, 64)
+    n = count_params(init_params(cfg, jax.random.key(0)))
+    assert est.params == n * 4  # fp32
+
+
+def test_fsdp_shards_param_bytes(eight_devices):
+    cfg = get_model_config("S", 64)
+    ddp = mem.estimate_hbm(cfg, get_strategy("ddp"), _mesh(8), 1, 64)
+    fsdp = mem.estimate_hbm(cfg, get_strategy("fsdp"), _mesh(8), 1, 64)
+    # Sharded params ~1/8 of replicated (within rounding of indivisible leaves).
+    assert fsdp.params < ddp.params * 0.2
+    assert fsdp.opt_state < ddp.opt_state * 0.2
+
+
+def test_zero2_shards_opt_but_not_params(eight_devices):
+    cfg = get_model_config("S", 64)
+    z2 = mem.estimate_hbm(cfg, get_strategy("zero2"), _mesh(8), 1, 64)
+    ddp = mem.estimate_hbm(cfg, get_strategy("ddp"), _mesh(8), 1, 64)
+    assert z2.params == ddp.params  # replicated
+    assert z2.opt_state < ddp.opt_state * 0.2  # sharded moments
+
+
+def test_reference_attention_dominates_long_seq():
+    """The O(S^2) materialized-attention term is present only for
+    attention_impl='reference' — the reason flash exists."""
+    ref = get_model_config("A", 8192, attention_impl="reference")
+    fla = get_model_config("A", 8192, attention_impl="flash")
+    strat = get_strategy("ddp")
+    e_ref = mem.estimate_hbm(ref, strat, _mesh(), 1, 8192)
+    e_fla = mem.estimate_hbm(fla, strat, _mesh(), 1, 8192)
+    assert e_ref.activations > 4 * e_fla.activations
+
+
+def test_tier_b_refused_on_v5e_any_single_chip_arm():
+    """1.68B params: fp32 params+grads+moments alone ~25 GiB > 16 GiB."""
+    for arm in ("ddp", "fsdp", "zero2", "zero3"):
+        strat = get_strategy(arm)
+        cfg = get_model_config("B", 2048, attention_impl="flash")
+        est = mem.estimate_hbm(cfg, strat, _mesh(), 1, 2048)
+        msg = mem.check_fits(est, "TPU v5 lite")
+        assert msg is not None, arm
+        assert "16 GiB" in msg
+
+
+def test_tier_a_fits_v5e():
+    cfg = get_model_config("A", 2048, attention_impl="flash")
+    est = mem.estimate_hbm(cfg, get_strategy("zero2"), _mesh(), 1, 2048)
+    assert mem.check_fits(est, "TPU v5 lite") is None
+
+
+def test_unknown_device_never_refused():
+    cfg = get_model_config("B", 2048)
+    est = mem.estimate_hbm(cfg, get_strategy("ddp"), _mesh(), 1, 2048)
+    assert mem.check_fits(est, "cpu") is None
+
+
+def test_capacity_table():
+    assert mem.device_hbm_bytes("TPU v5 lite") == 16 * 1024**3
+    assert mem.device_hbm_bytes("TPU v4") == 32 * 1024**3
+    assert mem.device_hbm_bytes("weird accelerator") is None
