@@ -44,6 +44,10 @@ def main():
     # (steps are device-sequential), but host RPC latency stays out of the
     # hot loop — see the timing-discipline note in train/loop.py.
     p.add_argument("--sync-every", type=int, default=10)
+    # Unrolled layer loop measures ~15% faster than lax.scan on one chip
+    # (no dynamic-update-slice activation stacking); scan remains the
+    # harness default for compile time and pipeline runs.
+    p.add_argument("--layer-loop", default="unrolled", choices=["scan", "unrolled"])
     args = p.parse_args()
 
     from distributed_llm_training_benchmark_framework_tpu.utils.platform import (
@@ -74,6 +78,7 @@ def main():
             attention_impl=args.attention,
             dropout=args.dropout,
             sync_every=args.sync_every,
+            layer_loop=args.layer_loop,
         )
 
     per_chip = result.tokens_per_sec / world

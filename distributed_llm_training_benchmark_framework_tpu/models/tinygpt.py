@@ -63,10 +63,19 @@ class TinyGPTConfig:
     flash_block_q: Optional[int] = None
     flash_block_k: Optional[int] = None
     flash_block_k_bwd: Optional[int] = None
+    # Hand-written Pallas backward kernels instead of the XLA-fused blockwise
+    # einsum backward (ops/flash_attention defaults to the latter; see its
+    # docstring for the v5e measurements behind the default).
+    flash_pallas_backward: bool = False
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     # Per-layer rematerialization (activation checkpointing) inside the scan.
     remat: bool = False
+    # lax.scan over stacked layer weights (one compiled block body, fast
+    # compile, what pipeline sharding needs) vs an unrolled Python loop
+    # (16x the HLO, but activations save as distinct buffers instead of
+    # dynamic-update-slice stacking — a tuning surface for single-chip runs).
+    scan_layers: bool = True
     # Mixture-of-Experts MLP (0 = dense). When > 0 every block's MLP becomes
     # a top-k routed expert layer (models.moe) and the training loss gains
     # the Switch load-balance auxiliary term.
@@ -220,32 +229,37 @@ def _attention(
     """Dispatch to the configured attention implementation. Returns (B,S,H,Dh).
 
     Attention-probability dropout (reference train_harness.py:116) applies in
-    the reference impl AND in the flash kernel (in-kernel, hash-based mask —
-    the probabilities still never materialize in HBM). The two draw from
-    different RNG streams (bernoulli vs coordinate hash), so with dropout > 0
-    flash-vs-reference parity is statistical, not per-step exact; set
-    dropout=0 for exact cross-impl loss comparison. The ring kernel applies
-    no attention dropout at all (documented deviation; the harness prints a
-    note).
+    ALL THREE impls: materialized bernoulli in 'reference', and the shared
+    global-coordinate hash mask in 'flash' (in-kernel) and 'ring' (per
+    rotating K/V block) — the probabilities still never materialize in HBM
+    for the latter two, and flash/ring produce bitwise-identical masks for
+    equal seeds. 'reference' draws from a different RNG stream (bernoulli),
+    so with dropout > 0 its parity vs flash/ring is statistical, not
+    per-step exact; set dropout=0 for exact cross-impl loss comparison.
     """
+    seed = None
+    if not deterministic and config.dropout > 0.0 and dropout_key is not None:
+        seed = jax.random.bits(dropout_key, (), jnp.uint32)
     if config.attention_impl == "flash":
         # Pallas TPU kernel; fp32 online-softmax accumulation internally.
         from ..ops.flash_attention import flash_attention
 
-        seed = None
-        if not deterministic and config.dropout > 0.0 and dropout_key is not None:
-            seed = jax.random.bits(dropout_key, (), jnp.uint32)
         return flash_attention(
             q, k, v, causal=config.causal,
             block_q=config.flash_block_q, block_k=config.flash_block_k,
             block_k_bwd=config.flash_block_k_bwd,
+            pallas_backward=config.flash_pallas_backward,
             dropout_rate=config.dropout if seed is not None else 0.0,
             dropout_seed=seed,
         )
     if config.attention_impl == "ring":
         from ..ops.ring_attention import ring_attention
 
-        return ring_attention(q, k, v, causal=config.causal)
+        return ring_attention(
+            q, k, v, causal=config.causal,
+            dropout_rate=config.dropout if seed is not None else 0.0,
+            dropout_seed=seed,
+        )
 
     # Reference jnp implementation: softmax(QK^T/sqrt(d))V with fp32 softmax.
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -364,6 +378,19 @@ def apply_blocks(
     block = functools.partial(_block, c, deterministic=deterministic)
     if c.remat:
         block = jax.checkpoint(block)
+
+    if not c.scan_layers:
+        n_local = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+        aux = jnp.zeros((), jnp.float32)
+        live = base_key is not None and not deterministic
+        for i in range(n_local):
+            layer = jax.tree_util.tree_map(lambda t: t[i], blocks)
+            ki = (
+                jax.random.fold_in(base_key, layer_offset + i) if live else None
+            )
+            x, a = block(x, layer, ki)
+            aux = aux + a
+        return x, aux
 
     if base_key is None or deterministic:
         def scan_body(carry, layer):

@@ -42,7 +42,14 @@ NEG_INF = -1e30
 
 
 def _mix32(x: jax.Array) -> jax.Array:
-    """32-bit integer finalizer (murmur3-style avalanche) on uint32 lanes."""
+    """32-bit integer finalizer (murmur3-style avalanche) on uint32 lanes.
+
+    Runs per score element in the flash kernels' hot loop, so the op count
+    was scrutinized: a single-multiply xorshift variant measured faster but
+    showed real adjacent-element keep correlation (pair rate 0.446 vs the
+    0.490 expected at rate 0.3) — biased dropout. Two multiplies is the
+    floor that passes the adjacency tests in tests/test_attention_ops.py.
+    """
     x = x.astype(jnp.uint32)
     x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
     x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)

@@ -31,25 +31,48 @@ from jax.sharding import PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, q_off, k_off, causal):
+def _block_attend(
+    q, k, v, q_off, k_off, causal,
+    bh0=None, seq_len=0, dropout_rate=0.0, dropout_seed=None,
+):
     """One (local-Q x one-KV-block) pass -> (scores-exp sum stats, weighted V).
 
     Returns (m, l, o): running-max (Sq,H,1), exp-sum (Sq,H,1), accumulator
     (Sq,H,D) for this block alone, with global-position causal masking.
+
+    Attention-probability dropout uses the SAME absolute-coordinate hash as
+    the flash kernel (flash_attention._dropout_keep) keyed by global
+    (batch*head, row, col): with equal seeds, ring and flash produce
+    bitwise-identical keep masks regardless of how the ring shards the
+    sequence. The exp-sum ``l`` accumulates the un-dropped probabilities
+    (dropout acts after normalization; normalization is linear), the
+    accumulator sees the dropped+rescaled ones.
     """
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("qhd,khd->hqk", q, k, preferred_element_type=jnp.float32) * scale
+    Sq, Sk = q.shape[0], k.shape[0]
+    rows = q_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+    cols = k_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
     if causal:
-        Sq, Sk = q.shape[0], k.shape[0]
-        rows = q_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
-        cols = k_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
         s = jnp.where((rows >= cols)[None, :, :], s, NEG_INF)
     m = jnp.max(s, axis=-1)                          # (H, Sq)
     p = jnp.exp(s - m[..., None])                    # (H, Sq, Sk)
     if causal:
         p = jnp.where(s <= NEG_INF / 2, 0.0, p)
     l = jnp.sum(p, axis=-1)                          # (H, Sq)
-    o = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+    if dropout_rate > 0.0 and dropout_seed is not None:
+        from .flash_attention import _dropout_keep, _dropout_threshold
+
+        H = q.shape[1]
+        bh = (bh0 + jnp.arange(H))[:, None, None]    # (H, 1, 1)
+        keep = _dropout_keep(
+            dropout_seed, bh, rows[None], cols[None], seq_len,
+            _dropout_threshold(dropout_rate),
+        )                                            # (H, Sq, Sk)
+        p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+    else:
+        p_acc = p
+    o = jnp.einsum("hqk,khd->qhd", p_acc, v.astype(jnp.float32))
     return m, l, o
 
 
@@ -59,14 +82,30 @@ def ring_attention_sharded(
     v: jax.Array,
     axis_name: str = "seq",
     causal: bool = False,
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jax.Array] = None,
+    batch_axis: Optional[str] = None,
+    heads_axis: Optional[str] = None,
 ) -> jax.Array:
-    """Ring attention body; call inside shard_map with seq sharded on axis_name."""
+    """Ring attention body; call inside shard_map with seq sharded on axis_name.
+
+    ``batch_axis``/``heads_axis`` name the mesh axes (if any) the batch and
+    head dims are sharded over, so dropout-mask coordinates are GLOBAL
+    (batch, head) indices — without them, same-local-index examples on
+    different data shards would share masks.
+    """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Sl, H, D = q.shape
+    S = n * Sl  # global sequence length (hash coordinates are global)
     perm = [(j, (j + 1) % n) for j in range(n)]
+    if dropout_seed is None:
+        dropout_rate = 0.0
+    b_off = lax.axis_index(batch_axis) * B if batch_axis else 0
+    h_off = lax.axis_index(heads_axis) * H if heads_axis else 0
+    n_heads = H * (lax.axis_size(heads_axis) if heads_axis else 1)
 
-    def one_batch(qb, kb, vb):
+    def one_batch(qb, kb, vb, bidx):
         q_off = my * Sl
         # n is a static mesh-axis size, so the ring unrolls as a Python loop:
         # no permute is issued after the final block (the rotated K/V would be
@@ -78,7 +117,12 @@ def ring_attention_sharded(
         for t in range(n):
             # After t forward hops the resident block originated on (my - t) % n.
             src = (my - t) % n
-            m_b, l_b, o_b = _block_attend(qb, k_cur, v_cur, q_off, src * Sl, causal)
+            m_b, l_b, o_b = _block_attend(
+                qb, k_cur, v_cur, q_off, src * Sl, causal,
+                # global (batch*heads) base: matches flash's b*H + h keying
+                bh0=(b_off + bidx) * n_heads + h_off, seq_len=S,
+                dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+            )
             # Merge online-softmax statistics (m_*: (H,Sq), o_*: (Sq,H,D)).
             m_new = jnp.maximum(m_run, m_b)
             a_run = jnp.exp(m_run - m_new)
@@ -95,7 +139,7 @@ def ring_attention_sharded(
         l_f = jnp.where(l_run == 0.0, 1.0, l_run)
         return (o_run / l_f.transpose(1, 0)[:, :, None]).astype(qb.dtype)
 
-    return jax.vmap(one_batch)(q, k, v)
+    return jax.vmap(one_batch)(q, k, v, jnp.arange(B))
 
 
 def ring_attention(
@@ -105,17 +149,27 @@ def ring_attention(
     causal: bool = False,
     axis_name: str = "seq",
     mesh: Optional[jax.sharding.Mesh] = None,
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Shard the sequence over ``axis_name`` and run the ring. Falls back to
     flash attention when no such mesh axis is in scope (so models configured
-    with attention_impl='ring' still run on a plain data mesh)."""
+    with attention_impl='ring' still run on a plain data mesh).
+
+    Attention-probability dropout (``dropout_rate`` + uint32 ``dropout_seed``)
+    uses the flash kernel's global-coordinate hash: for equal seeds the mask
+    is identical to flash's, independent of the ring's sequence sharding.
+    """
     if mesh is None:
         m = jax.sharding.get_abstract_mesh()
         mesh = m if m is not None and axis_name in getattr(m, "axis_names", ()) else None
     if mesh is None or mesh.shape.get(axis_name, 1) == 1:
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(
+            q, k, v, causal=causal,
+            dropout_rate=dropout_rate, dropout_seed=dropout_seed,
+        )
 
     # Compose with whatever other parallelism the mesh carries: batch stays
     # sharded on 'data', heads stay sharded on 'model' (tensor parallel) —
@@ -123,10 +177,19 @@ def ring_attention(
     batch_ax = "data" if mesh.shape.get("data", 1) > 1 else None
     model_ax = "model" if mesh.shape.get("model", 1) > 1 else None
     spec = P(batch_ax, axis_name, model_ax, None)
+    if dropout_seed is None:
+        seed = jnp.zeros((), jnp.uint32)
+        dropout_rate = 0.0
+    else:
+        seed = jnp.asarray(dropout_seed, jnp.uint32).reshape(())
+    def body(qs, ks, vs, seed_s):
+        return ring_attention_sharded(
+            qs, ks, vs, axis_name=axis_name, causal=causal,
+            dropout_rate=dropout_rate, dropout_seed=seed_s,
+            batch_axis=batch_ax, heads_axis=model_ax,
+        )
+
     fn = jax.shard_map(
-        functools.partial(ring_attention_sharded, axis_name=axis_name, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+        body, mesh=mesh, in_specs=(spec, spec, spec, P()), out_specs=spec
     )
-    return fn(q, k, v)
+    return fn(q, k, v, seed)

@@ -91,6 +91,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Flash-attention q tile size (default: kernel-tuned)")
     p.add_argument("--flash-block-k", type=int, default=None,
                    help="Flash-attention k tile size (default: kernel-tuned)")
+    p.add_argument("--prng-impl", choices=["rbg", "threefry"], default="rbg",
+                   help="Dropout-key PRNG: rbg (fast, default) or threefry "
+                        "(bit-reproducible across backends)")
+    p.add_argument("--layer-loop", choices=["scan", "unrolled"], default="scan",
+                   help="Transformer layer iteration: lax.scan over stacked "
+                        "weights (fast compile) or an unrolled loop (~15% "
+                        "faster single-chip step; slower compile)")
+    p.add_argument("--flash-pallas-backward", action="store_true",
+                   help="Use the hand-written Pallas backward kernels instead "
+                        "of the XLA-fused blockwise einsum backward")
     p.add_argument("--flash-block-k-bwd", type=int, default=None,
                    help="Flash-attention backward k tile size (the fwd/bwd "
                         "optima differ; default: kernel-tuned)")
@@ -213,6 +223,9 @@ def main(argv=None) -> int:
             flash_block_q=args.flash_block_q,
             flash_block_k=args.flash_block_k,
             flash_block_k_bwd=args.flash_block_k_bwd,
+            flash_pallas_backward=args.flash_pallas_backward,
+            layer_loop=args.layer_loop,
+            prng_impl=args.prng_impl,
             dataset_size=args.dataset_size,
             sync_every=args.sync_every,
             profile_dir=args.profile_dir,

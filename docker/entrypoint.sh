@@ -43,12 +43,14 @@ export WARMUP_STEPS="${WARMUP_STEPS:-5}"
 export PER_DEVICE_BATCH="${PER_DEVICE_BATCH:-1}"
 export GRAD_ACCUM="${GRAD_ACCUM:-1}"
 export ATTENTION="${ATTENTION:-reference}"
+export LAYER_LOOP="${LAYER_LOOP:-scan}"
 export SYNTHETIC="${SYNTHETIC:-true}"
 export RESULTS_DIR="${RESULTS_DIR:-/results}"
 
 echo "Config:"
 for v in STRATEGY WORLD_SIZE NUM_PROCESSES RANK MASTER_ADDR MASTER_PORT \
-         SEQ_LEN TIER STEPS WARMUP_STEPS PER_DEVICE_BATCH GRAD_ACCUM ATTENTION; do
+         SEQ_LEN TIER STEPS WARMUP_STEPS PER_DEVICE_BATCH GRAD_ACCUM \
+         ATTENTION LAYER_LOOP; do
   echo "  $v=${!v}"
 done
 echo ""
@@ -66,7 +68,8 @@ ARGS="${ARGS} --master-addr ${MASTER_ADDR} --master-port ${MASTER_PORT}"
 ARGS="${ARGS} --seq-len ${SEQ_LEN} --tier ${TIER} --steps ${STEPS}"
 ARGS="${ARGS} --warmup-steps ${WARMUP_STEPS}"
 ARGS="${ARGS} --per-device-batch ${PER_DEVICE_BATCH} --grad-accum ${GRAD_ACCUM}"
-ARGS="${ARGS} --attention ${ATTENTION} --results-dir ${RESULTS_DIR}"
+ARGS="${ARGS} --attention ${ATTENTION} --layer-loop ${LAYER_LOOP}"
+ARGS="${ARGS} --results-dir ${RESULTS_DIR}"
 if [[ "${SYNTHETIC}" == "true" ]]; then ARGS="${ARGS} --synthetic"; fi
 if [[ "${STRATEGY}" == "zero2" || "${STRATEGY}" == "zero3" ]]; then
   ARGS="${ARGS} --strategy-config /app/configs/strategies/${STRATEGY}.json"

@@ -16,6 +16,7 @@ STEPS=100
 PER_DEVICE_BATCH=1
 GRAD_ACCUM=4
 ATTENTION="reference"
+LAYER_LOOP="scan"
 IMAGE="tpu-llm-bench:latest"
 TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
@@ -33,6 +34,7 @@ while [ $# -gt 0 ]; do
     --per-device-batch) PER_DEVICE_BATCH="$2"; shift 2 ;;
     --grad-accum) GRAD_ACCUM="$2"; shift 2 ;;
     --attention) ATTENTION="$2"; shift 2 ;;
+    --layer-loop) LAYER_LOOP="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
     --job-name) JOB_NAME="$2"; shift 2 ;;
@@ -64,6 +66,7 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{PER_DEVICE_BATCH}}|$PER_DEVICE_BATCH|g" \
     -e "s|{{GRAD_ACCUM}}|$GRAD_ACCUM|g" \
     -e "s|{{ATTENTION}}|$ATTENTION|g" \
+    -e "s|{{LAYER_LOOP}}|$LAYER_LOOP|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \
     -e "s|{{TPU_ACCELERATOR}}|$TPU_ACCELERATOR|g" \
     -e "s|{{TPU_TOPOLOGY}}|$TPU_TOPOLOGY|g" \
