@@ -38,7 +38,7 @@ def main():
     # probabilities still never materialize in HBM). Pass
     # --attention reference for the materialized-softmax run.
     p.add_argument("--attention", default="flash",
-                   choices=["reference", "flash", "ring"])
+                   choices=["reference", "flash", "ring", "ulysses"])
     p.add_argument("--dropout", type=float, default=None)
     # Hard-sync every N steps instead of every step: totals are identical
     # (steps are device-sequential), but host RPC latency stays out of the

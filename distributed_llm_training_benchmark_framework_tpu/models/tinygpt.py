@@ -260,6 +260,14 @@ def _attention(
             dropout_rate=config.dropout if seed is not None else 0.0,
             dropout_seed=seed,
         )
+    if config.attention_impl == "ulysses":
+        from ..ops.ulysses_attention import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, causal=config.causal,
+            dropout_rate=config.dropout if seed is not None else 0.0,
+            dropout_seed=seed,
+        )
 
     # Reference jnp implementation: softmax(QK^T/sqrt(d))V with fp32 softmax.
     scale = 1.0 / (q.shape[-1] ** 0.5)

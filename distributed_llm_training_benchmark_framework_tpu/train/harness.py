@@ -82,7 +82,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Use synthetic data (always true; flag kept live+honest)")
     p.add_argument("--dataset-size", type=int, default=1000)
     p.add_argument("--attention", type=str, default="reference",
-                   choices=["reference", "flash", "ring"],
+                   choices=["reference", "flash", "ring", "ulysses"],
                    help="Attention kernel implementation")
     p.add_argument("--dropout", type=float, default=None,
                    help="Override model dropout rate (default: tier's 0.1, "

@@ -115,12 +115,14 @@ def _run_benchmark_impl(
         ("data", "seq", "model", "pipe", "expert"),
         devices=devices[:world_size],
     )
-    if sp > 1 and attention_impl != "ring":
-        raise ValueError("sequence_parallel > 1 requires --attention ring")
-    if pp > 1 and attention_impl == "ring":
+    if sp > 1 and attention_impl not in ("ring", "ulysses"):
         raise ValueError(
-            "pipeline_parallel does not compose with ring attention yet; "
-            "use dp/tp/pp"
+            "sequence_parallel > 1 requires --attention ring or ulysses"
+        )
+    if pp > 1 and attention_impl in ("ring", "ulysses"):
+        raise ValueError(
+            "pipeline_parallel does not compose with sequence-parallel "
+            "attention (ring/ulysses) yet; use dp/tp/pp"
         )
     if pp > 1 and tp > 1 and jax.default_backend() == "cpu":
         # XLA's CPU-only AllReducePromotion pass aborts the process compiling

@@ -86,6 +86,22 @@ def test_dp_sp_tp_all_at_once(eight_devices):
     np.testing.assert_allclose(full, base, rtol=5e-3)
 
 
+def test_sp_ulysses_matches_ddp_trajectory(eight_devices):
+    """All-to-all (Ulysses) sequence parallelism walks the same trajectory as
+    plain ddp — same bar as the ring variant, different comm pattern."""
+    base = run_steps(make_state("ddp", (2, 1, 1)), 3, dp=2)
+    sp = run_steps(make_state("ddp", (2, 4, 1), attention="ulysses"), 3, dp=2)
+    np.testing.assert_allclose(sp, base, rtol=5e-3)
+
+
+def test_dp_sp_ulysses_tp(eight_devices):
+    """Ulysses composes with data + tensor parallelism (local heads H/tp
+    must still divide the seq axis: tier S has 4 heads, tp=2 -> 2, sp=2 ok)."""
+    base = run_steps(make_state("zero2", (2, 1, 1)), 3, dp=2)
+    full = run_steps(make_state("zero2", (2, 2, 2), attention="ulysses"), 3, dp=2)
+    np.testing.assert_allclose(full, base, rtol=5e-3)
+
+
 def test_world_size_not_divisible_raises():
     from distributed_llm_training_benchmark_framework_tpu.train.loop import run_benchmark
     from distributed_llm_training_benchmark_framework_tpu.parallel import get_strategy
