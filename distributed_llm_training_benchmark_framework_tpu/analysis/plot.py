@@ -104,7 +104,7 @@ def make_plots(df: pd.DataFrame, out_dir: str) -> List[str]:
         # pre-flight analytic estimate otherwise (all-zero measured column).
         mem_col, mem_label = "peak_vram_gb", "Peak HBM (GB)"
         if df["peak_vram_gb"].max() == 0 and "est_hbm_gb" in df.columns:
-            mem_col, mem_label = "est_hbm_gb", "Estimated HBM (GiB)"
+            mem_col, mem_label = "est_hbm_gb", "Estimated HBM (GB)"
         fig, ax = plt.subplots(figsize=(7, 4.5))
         for i, (key, g) in enumerate(sorted(df.groupby(_seq_key_cols(df)))):
             key = key if isinstance(key, tuple) else (key,)

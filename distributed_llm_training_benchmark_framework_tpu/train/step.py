@@ -40,6 +40,9 @@ class TrainState:
     params: Params
     opt_state: Any
     step_fn: Callable  # (params, opt_state, batch, step) -> (params, opt_state, loss)
+    # (params, opt_state, batch, step) -> jax.stages.Compiled for the step —
+    # cache hit after the first execution; feeds measure_peak_hbm rung 2.
+    aot_compile: Callable
     mesh: Mesh
     param_specs: Params
     opt_specs: Any
@@ -226,7 +229,18 @@ def make_train_step(
         with jax.set_mesh(mesh):
             return jitted(params, opt_state, batch, step)
 
-    return step_with_mesh
+    def aot_compile(params, opt_state, batch, step=0):
+        """AOT-compile for the given args and return the jax.stages.Compiled.
+
+        After the jit has executed once this is a cache hit (<1ms) — the AOT
+        path shares the jit executable cache — so it is the free way to get
+        ``compiled.memory_analysis()`` (XLA's measured buffer-assignment
+        peak) on runtimes whose allocator exposes no ``memory_stats()``.
+        """
+        with jax.set_mesh(mesh):
+            return jitted.lower(params, opt_state, batch, step).compile()
+
+    return step_with_mesh, aot_compile
 
 
 def create_train_state(
@@ -269,7 +283,7 @@ def create_train_state(
             optimizer.init, out_shardings=strat.named(mesh, opt_specs)
         )(params)
 
-    step_fn = make_train_step(
+    step_fn, aot_compile = make_train_step(
         model_config,
         strategy,
         optimizer,
@@ -288,6 +302,7 @@ def create_train_state(
         params=params,
         opt_state=opt_state,
         step_fn=step_fn,
+        aot_compile=aot_compile,
         mesh=mesh,
         param_specs=param_specs,
         opt_specs=opt_specs,
