@@ -123,6 +123,15 @@ def build_report(
             f"{best_mfu['mfu_pct']:.1f}% of bf16 peak"
             f" (seq {int(best_mfu['seq_len'])}{impl})"
         )
+    if "tokens_per_dollar" in df.columns and (df["tokens_per_dollar"] > 0).any():
+        # Cost-efficiency headline (reference README.md:270-276 analogue).
+        best_cost = df.loc[df["tokens_per_dollar"].idxmax()]
+        out.append(
+            f"- **Best cost efficiency:** {best_cost['strategy']} at "
+            f"{best_cost['tokens_per_dollar']/1e6:,.1f}M tokens/$ "
+            f"(${best_cost['usd_per_chip_hour']:.2f}/chip-hr on-demand, "
+            f"seq {int(best_cost['seq_len'])})"
+        )
     out.append("")
 
     out += ["## Plots", ""]

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Executable result-sanity checks (the validation envelopes, enforced).
+
+The reference *documents* expected-result bands for operators to eyeball
+(reference ``results/example_output/README.md:120-146``: loss range, <10%
+step-time variance, plausible VRAM); this repo's
+``results/example_output/README.md`` documents the TPU equivalents. This
+module turns those prose envelopes into a suite step that fails loudly:
+
+- **schema**: every ``result*.json`` carries the reference-contract keys with
+  sane values (tokens_per_sec > 0, step time > 0);
+- **markers**: every captured run log contains exactly one
+  ``BENCHMARK_RESULT_JSON_START``/``_END`` pair whose payload parses — the
+  contract the kubectl-logs collector scrapes (reference
+  ``scripts/collect_results.sh:50-59``);
+- **loss band**: mean_loss below the ~ln(V) random-init ceiling and above a
+  degenerate floor — training happened and did not diverge/NaN;
+- **step-time variance**: coefficient of variation < 10% over the timed
+  steps (reference envelope "<10% variance"), checked only where
+  ``sync_every == 1`` makes per-step times individually meaningful;
+- **memory**: measured peak (when the platform reports one) and the
+  analytic estimate agree within a stated tolerance, and neither exceeds
+  the device's HBM capacity.
+
+Exit code 0 = all envelopes hold; 1 = any violation (listed on stdout).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+from typing import List, Optional, Tuple
+
+MARKER_START = "BENCHMARK_RESULT_JSON_START"
+MARKER_END = "BENCHMARK_RESULT_JSON_END"
+
+# mean_loss over the first ~100 steps must land inside (FLOOR, ln(V) + SLACK).
+# A mean below FLOOR at benchmark step counts means the loss collapsed (data
+# leak / targets bug); above the ceiling means it never trained or diverged.
+LOSS_FLOOR = 0.05
+LOSS_CEIL_SLACK = 0.5
+STEP_CV_LIMIT_PCT = 10.0
+# utils/memory.py's documented accuracy claim for the analytic model,
+# validated here against the measured column whenever one exists.
+EST_VS_MEASURED_TOL = 0.35
+
+
+def _check(ok: bool, label: str, detail: str, failures: List[str]) -> None:
+    if not ok:
+        failures.append(f"{label}: {detail}")
+
+
+def validate_result(r: dict, name: str) -> List[str]:
+    """Envelope-check one result dict; returns a list of violations."""
+    f: List[str] = []
+    for key in (
+        "strategy", "world_size", "seq_len", "tokens_per_sec",
+        "mean_step_time_sec", "mean_loss", "peak_vram_gb", "h2d_gbps_per_gpu",
+    ):
+        _check(key in r, name, f"missing reference-schema key {key!r}", f)
+    if f:
+        return f
+
+    _check(r["tokens_per_sec"] > 0, name,
+           f"tokens_per_sec={r['tokens_per_sec']} (must be > 0)", f)
+    _check(r["mean_step_time_sec"] > 0, name,
+           f"mean_step_time_sec={r['mean_step_time_sec']} (must be > 0)", f)
+
+    loss = r["mean_loss"]
+    vocab = 32000  # TinyGPT tiers share the reference vocab
+    ceil = math.log(vocab) + LOSS_CEIL_SLACK
+    _check(
+        LOSS_FLOOR < loss < ceil, name,
+        f"mean_loss={loss:.4f} outside ({LOSS_FLOOR}, ln({vocab})+"
+        f"{LOSS_CEIL_SLACK}={ceil:.2f}) — not training or diverged", f,
+    )
+    _check(loss == loss, name, "mean_loss is NaN", f)
+
+    if r.get("sync_every", 1) == 1 and r.get("step_time_cv_pct", 0) > 0:
+        cv = r["step_time_cv_pct"]
+        _check(
+            cv < STEP_CV_LIMIT_PCT, name,
+            f"step-time cv {cv:.1f}% >= {STEP_CV_LIMIT_PCT}% envelope", f,
+        )
+
+    est = r.get("est_hbm_gb", 0.0)
+    measured = r.get("peak_hbm_gb", 0.0)
+    method = r.get("peak_hbm_method", "unavailable")
+    if est > 0 and measured > 0 and method in ("allocator", "xla_buffer_assignment"):
+        rel = abs(measured - est) / measured
+        _check(
+            rel <= EST_VS_MEASURED_TOL, name,
+            f"analytic est {est:.2f} GB vs measured {measured:.2f} GB "
+            f"({method}) differ by {100*rel:.0f}% > "
+            f"{100*EST_VS_MEASURED_TOL:.0f}% tolerance", f,
+        )
+    cap = _hbm_capacity_gb(r.get("device_kind", ""))
+    if cap is not None:
+        for label, val in (("measured peak", measured), ("estimate", est)):
+            _check(
+                val <= cap, name,
+                f"{label} {val:.2f} GB exceeds {cap:.1f} GB {r['device_kind']} HBM", f,
+            )
+    return f
+
+
+def _hbm_capacity_gb(device_kind: str) -> Optional[float]:
+    if not device_kind:
+        return None
+    try:
+        from ..utils.memory import device_hbm_bytes
+    except ImportError:  # run as a standalone script
+        from distributed_llm_training_benchmark_framework_tpu.utils.memory import (
+            device_hbm_bytes,
+        )
+    b = device_hbm_bytes(device_kind)
+    return b / 1e9 if b else None
+
+
+def validate_log(path: str) -> List[str]:
+    """Check the stdout-marker contract in one captured run log."""
+    name = os.path.basename(path)
+    f: List[str] = []
+    text = open(path, errors="replace").read()
+    n_start, n_end = text.count(MARKER_START), text.count(MARKER_END)
+    _check(
+        n_start == 1 and n_end == 1, name,
+        f"expected exactly one marker pair, found {n_start} start / {n_end} end", f,
+    )
+    if n_start >= 1 and n_end >= 1:
+        payload = text.split(MARKER_START, 1)[1].split(MARKER_END, 1)[0]
+        try:
+            json.loads(payload)
+        except json.JSONDecodeError as e:
+            f.append(f"{name}: marker payload is not valid JSON ({e})")
+    return f
+
+
+def collect(results_dir: str, logs_dir: Optional[str]) -> Tuple[List[str], int]:
+    failures: List[str] = []
+    result_files = sorted(
+        glob.glob(os.path.join(results_dir, "**", "result*.json"), recursive=True)
+    )
+    n = 0
+    for path in result_files:
+        name = os.path.relpath(path, results_dir)
+        try:
+            r = json.load(open(path))
+        except json.JSONDecodeError as e:
+            failures.append(f"{name}: invalid JSON ({e})")
+            continue
+        failures.extend(validate_result(r, name))
+        n += 1
+    if logs_dir and os.path.isdir(logs_dir):
+        for path in sorted(glob.glob(os.path.join(logs_dir, "*.log"))):
+            failures.extend(validate_log(path))
+            n += 1
+    return failures, n
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--results-dir", required=True,
+                   help="directory searched recursively for result*.json")
+    p.add_argument("--logs-dir", default=None,
+                   help="optional directory of captured run logs (marker check)")
+    args = p.parse_args(argv)
+    failures, n = collect(args.results_dir, args.logs_dir)
+    if n == 0:
+        print(f"VALIDATE: no results found under {args.results_dir}")
+        return 1
+    for msg in failures:
+        print(f"VALIDATE FAIL {msg}")
+    verdict = "FAIL" if failures else "PASS"
+    print(f"VALIDATE {verdict}: {n} artifacts checked, {len(failures)} violations")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -43,6 +43,22 @@ from jax import lax
 
 Params = Dict[str, Any]
 
+REMAT_POLICIES = ("none", "dots", "full")
+
+
+def normalize_remat(value: Any) -> str:
+    """Normalize a remat policy: accepts "none"/"dots"/"full" or a legacy
+    bool (True = "full"). "auto" must be resolved (utils.memory
+    .resolve_auto_remat) before it reaches the model."""
+    if isinstance(value, bool):
+        return "full" if value else "none"
+    if value in REMAT_POLICIES:
+        return value
+    raise ValueError(
+        f"invalid remat policy {value!r} (expected one of {REMAT_POLICIES}, "
+        "a bool, or 'auto' resolved upstream)"
+    )
+
 
 @dataclasses.dataclass(frozen=True)
 class TinyGPTConfig:
@@ -69,8 +85,15 @@ class TinyGPTConfig:
     flash_pallas_backward: bool = False
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
-    # Per-layer rematerialization (activation checkpointing) inside the scan.
-    remat: bool = False
+    # Per-layer rematerialization policy inside the scan:
+    #   "none" — save every intermediate (fastest, most memory);
+    #   "dots" — jax.checkpoint with the save-dots-class policy: matmul
+    #            outputs are kept, only cheap elementwise/softmax work is
+    #            recomputed in backward (the low-tax middle ground);
+    #   "full" — all-or-nothing jax.checkpoint per layer (least memory,
+    #            ~full forward recompute in backward).
+    # Booleans are accepted for backward compatibility (True="full").
+    remat: Any = "none"
     # lax.scan over stacked layer weights (one compiled block body, fast
     # compile, what pipeline sharding needs) vs an unrolled Python loop
     # (16x the HLO, but activations save as distinct buffers instead of
@@ -384,8 +407,18 @@ def apply_blocks(
     """
     c = config
     block = functools.partial(_block, c, deterministic=deterministic)
-    if c.remat:
+    pol = normalize_remat(c.remat)
+    if pol == "full":
         block = jax.checkpoint(block)
+    elif pol == "dots":
+        # Save matmul (dot_general without dot-batch dims, i.e. x @ W)
+        # outputs; recompute only LN/GELU/softmax/dropout in backward —
+        # removes most of full remat's recompute tax while still dropping
+        # the elementwise intermediates from liveness.
+        block = jax.checkpoint(
+            block,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
 
     if not c.scan_layers:
         n_local = jax.tree_util.tree_leaves(blocks)[0].shape[0]

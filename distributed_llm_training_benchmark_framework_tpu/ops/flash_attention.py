@@ -56,7 +56,7 @@ def _mix32(x: jax.Array) -> jax.Array:
     return x ^ (x >> 16)
 
 
-def _dropout_keep(seed, bh, rows, cols, seq_len: int, threshold) -> jax.Array:
+def _dropout_keep(seed, bh, rows, cols, threshold) -> jax.Array:
     """Deterministic per-element keep mask for attention-probability dropout.
 
     Derived from the absolute (batch*head, row, col) coordinate — NOT from
@@ -64,18 +64,37 @@ def _dropout_keep(seed, bh, rows, cols, seq_len: int, threshold) -> jax.Array:
     blockwise backward, and the Pallas backward kernels reproduce the exact
     same mask even though they tile the (S, S) matrix differently.
     ``seed`` is a traced uint32 scalar; ``threshold`` = keep_prob * 2^32.
+
+    Each of (bh, row) gets its own fully-avalanched 32-bit stream base, so
+    two rows (same or different heads) only ever share keep bits where two
+    independent 32-bit hashes collide (~2^-32 per pair) — unlike an affine
+    ``base + row*S + col`` packing, where B*H*S^2 > 2^32 forces systematic
+    shifted-identical masks across heads by pigeonhole. Per-element cost is
+    unchanged (one finalizer on the broadcast (rows, cols) product); the
+    row mix runs on the narrow rows operand.
     """
     base = _mix32(seed + jnp.uint32(bh) * jnp.uint32(0x9E3779B9))
-    h = _mix32(
-        base
-        + rows.astype(jnp.uint32) * jnp.uint32(seq_len)
-        + cols.astype(jnp.uint32)
-    )
+    rowbase = _mix32(base + rows.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+    h = _mix32(rowbase + cols.astype(jnp.uint32))
     return h < threshold
 
 
 def _dropout_threshold(rate: float) -> jnp.uint32:
     return jnp.uint32(min(int((1.0 - rate) * 2**32), 2**32 - 1))
+
+
+def _warn_seedless_dropout(dropout_rate: float, api_name: str) -> None:
+    """A caller passing dropout_rate>0 without a seed gets *deterministic*
+    attention; make that audible instead of silent (advisor finding r2)."""
+    if dropout_rate > 0.0:
+        import warnings
+
+        warnings.warn(
+            f"{api_name}: dropout_rate > 0 with dropout_seed=None — dropout "
+            "is DISABLED (deterministic attention). Pass a uint32 "
+            "dropout_seed to enable it.",
+            stacklevel=3,
+        )
 
 
 def _pick_block(seq_len: int, preferred: int = 512) -> int:
@@ -126,8 +145,10 @@ def _flash_fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (bq, bk) fp32
 
-        rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        # Narrow coordinate operands: the causal compare and the dropout
+        # hash broadcast (bq,1)x(1,bk); the row-fold mix runs per-row only.
+        rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = ki * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         if causal:
             mask = rows >= cols
             s = jnp.where(mask, s, NEG_INF)
@@ -147,7 +168,7 @@ def _flash_fwd_kernel(
         # exact), while the output accumulator sees the dropped+rescaled p.
         if dropout_rate > 0.0:
             keep = _dropout_keep(
-                seed_ref[0], bh, rows, cols, seq_len,
+                seed_ref[0], bh, rows, cols,
                 _dropout_threshold(dropout_rate),
             )
             p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
@@ -261,8 +282,10 @@ def _bwd_dq_kernel(
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        # Narrow coordinate operands: the causal compare and the dropout
+        # hash broadcast (bq,1)x(1,bk); the row-fold mix runs per-row only.
+        rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = ki * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         if causal:
             mask = rows >= cols
             s = jnp.where(mask, s, NEG_INF)
@@ -274,7 +297,7 @@ def _bwd_dq_kernel(
         )
         if dropout_rate > 0.0:
             keep = _dropout_keep(
-                seed_ref[0], bh, rows, cols, seq_len,
+                seed_ref[0], bh, rows, cols,
                 _dropout_threshold(dropout_rate),
             )
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
@@ -318,8 +341,10 @@ def _bwd_dkv_kernel(
         s = lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        # Narrow coordinate operands: the causal compare and the dropout
+        # hash broadcast (bq,1)x(1,bk); the row-fold mix runs per-row only.
+        rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = ki * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         if causal:
             mask = rows >= cols
             s = jnp.where(mask, s, NEG_INF)
@@ -331,7 +356,7 @@ def _bwd_dkv_kernel(
         )
         if dropout_rate > 0.0:
             keep = _dropout_keep(
-                seed_ref[0], bh, rows, cols, seq_len,
+                seed_ref[0], bh, rows, cols,
                 _dropout_threshold(dropout_rate),
             )
             inv = 1.0 / (1.0 - dropout_rate)
@@ -399,7 +424,7 @@ def _jnp_blockwise_bwd(causal, bk, rate, res, do):
         if rate > 0.0:
             keep = _dropout_keep(
                 seed[0], bh_idx[:, None, None], rows[None, :, None],
-                cols[None, None, :], S, threshold,
+                cols[None, None, :], threshold,
             )  # (BH, S, bk)
             inv = 1.0 / (1.0 - rate)
             pd = jnp.where(keep, p * inv, 0.0)
@@ -534,8 +559,8 @@ def flash_attention(
     reference's ``nn.MultiheadAttention(dropout=...)`` (train_harness.py:116)
     that earlier rounds had to document as a deviation. The keep mask is a
     stateless hash of absolute coordinates, so fwd/bwd agree despite their
-    different tilings. With ``dropout_seed=None`` the rate is ignored
-    (matching the model's deterministic/no-key dropout convention).
+    different tilings. With ``dropout_seed=None`` the rate is ignored and a
+    warning is emitted (the model's deterministic/no-key dropout convention).
     """
     B, S, H, D = q.shape
     if interpret is None:
@@ -549,6 +574,7 @@ def flash_attention(
             f"must divide seq_len={S}"
         )
     if dropout_seed is None:
+        _warn_seedless_dropout(dropout_rate, "flash_attention")
         dropout_rate = 0.0
         seed = jnp.zeros((1,), jnp.uint32)
     else:

@@ -33,7 +33,7 @@ NEG_INF = -1e30
 
 def _block_attend(
     q, k, v, q_off, k_off, causal,
-    bh0=None, seq_len=0, dropout_rate=0.0, dropout_seed=None,
+    bh0=None, dropout_rate=0.0, dropout_seed=None,
 ):
     """One (local-Q x one-KV-block) pass -> (scores-exp sum stats, weighted V).
 
@@ -51,8 +51,8 @@ def _block_attend(
     scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("qhd,khd->hqk", q, k, preferred_element_type=jnp.float32) * scale
     Sq, Sk = q.shape[0], k.shape[0]
-    rows = q_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
-    cols = k_off + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+    rows = q_off + lax.broadcasted_iota(jnp.int32, (Sq, 1), 0)
+    cols = k_off + lax.broadcasted_iota(jnp.int32, (1, Sk), 1)
     if causal:
         s = jnp.where((rows >= cols)[None, :, :], s, NEG_INF)
     m = jnp.max(s, axis=-1)                          # (H, Sq)
@@ -66,7 +66,7 @@ def _block_attend(
         H = q.shape[1]
         bh = (bh0 + jnp.arange(H))[:, None, None]    # (H, 1, 1)
         keep = _dropout_keep(
-            dropout_seed, bh, rows[None], cols[None], seq_len,
+            dropout_seed, bh, rows[None], cols[None],
             _dropout_threshold(dropout_rate),
         )                                            # (H, Sq, Sk)
         p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
@@ -97,9 +97,11 @@ def ring_attention_sharded(
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Sl, H, D = q.shape
-    S = n * Sl  # global sequence length (hash coordinates are global)
     perm = [(j, (j + 1) % n) for j in range(n)]
     if dropout_seed is None:
+        from .flash_attention import _warn_seedless_dropout
+
+        _warn_seedless_dropout(dropout_rate, "ring_attention_sharded")
         dropout_rate = 0.0
     b_off = lax.axis_index(batch_axis) * B if batch_axis else 0
     h_off = lax.axis_index(heads_axis) * H if heads_axis else 0
@@ -120,7 +122,7 @@ def ring_attention_sharded(
             m_b, l_b, o_b = _block_attend(
                 qb, k_cur, v_cur, q_off, src * Sl, causal,
                 # global (batch*heads) base: matches flash's b*H + h keying
-                bh0=(b_off + bidx) * n_heads + h_off, seq_len=S,
+                bh0=(b_off + bidx) * n_heads + h_off,
                 dropout_rate=dropout_rate, dropout_seed=dropout_seed,
             )
             # Merge online-softmax statistics (m_*: (H,Sq), o_*: (Sq,H,D)).
@@ -178,6 +180,9 @@ def ring_attention(
     model_ax = "model" if mesh.shape.get("model", 1) > 1 else None
     spec = P(batch_ax, axis_name, model_ax, None)
     if dropout_seed is None:
+        from .flash_attention import _warn_seedless_dropout
+
+        _warn_seedless_dropout(dropout_rate, "ring_attention")
         seed = jnp.zeros((), jnp.uint32)
         dropout_rate = 0.0
     else:

@@ -166,6 +166,9 @@ def ulysses_attention(
 
     spec = P(batch_ax, axis_name, model_ax, None)
     if dropout_seed is None:
+        from .flash_attention import _warn_seedless_dropout
+
+        _warn_seedless_dropout(dropout_rate, "ulysses_attention")
         seed = jnp.zeros((), jnp.uint32)
         dropout_rate = 0.0
     else:

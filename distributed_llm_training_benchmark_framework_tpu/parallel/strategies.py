@@ -62,8 +62,12 @@ class StrategyConfig:
     shard_params: bool = False
     shard_grads: bool = False
     shard_opt_state: bool = False
-    # per-layer rematerialization inside the block scan
-    remat: bool = False
+    # per-layer rematerialization policy inside the block scan:
+    # "none" | "dots" (save matmul outputs) | "full" | "auto" (pick the
+    # cheapest policy whose memory estimate fits the device — resolved by
+    # utils.memory.resolve_auto_remat before training). Legacy bools accepted
+    # in JSON configs (True = "full").
+    remat: str = "none"
     # compute precision for matmuls ('bf16' | 'f32')
     precision: str = "bf16"
 
@@ -73,8 +77,8 @@ class StrategyConfig:
             f"grads={'reduce-scatter' if self.shard_grads else 'all-reduce'}",
             f"opt_state={'sharded' if self.shard_opt_state else 'replicated'}",
         ]
-        if self.remat:
-            bits.append("remat=per-layer")
+        if self.remat != "none":
+            bits.append(f"remat={self.remat}")
         return f"{self.name}: " + ", ".join(bits)
 
 
@@ -97,7 +101,11 @@ STRATEGIES: Dict[str, StrategyConfig] = {
         shard_opt_state=True,
         warmup_steps=5,
         grad_clip=1.0,
-        remat=True,
+        # DeepSpeed stage 3 pays a recompute/gather tax only when memory
+        # pressure demands it; blanket per-layer remat measured a ~20%
+        # single-chip throughput tax where the arm fit comfortably without
+        # it (docs/PERFORMANCE.md). "auto" picks the cheapest fitting policy.
+        remat="auto",
     ),
 }
 
@@ -106,6 +114,18 @@ def get_strategy(name: str) -> StrategyConfig:
     if name not in STRATEGIES:
         raise ValueError(f"Unknown strategy {name!r} (expected one of {sorted(STRATEGIES)})")
     return STRATEGIES[name]
+
+
+def _normalize_remat_field(value: Any) -> str:
+    """JSON remat field: bool (legacy, True="full") or policy string."""
+    if isinstance(value, bool):
+        return "full" if value else "none"
+    if value in ("none", "dots", "full", "auto"):
+        return value
+    raise ValueError(
+        f"invalid remat value {value!r} in strategy config "
+        "(expected bool or one of 'none'/'dots'/'full'/'auto')"
+    )
 
 
 def load_strategy_config(path: str) -> StrategyConfig:
@@ -143,7 +163,7 @@ def load_strategy_config(path: str) -> StrategyConfig:
         shard_params=bool(shard.get("params", base.shard_params)),
         shard_grads=bool(shard.get("grads", base.shard_grads)),
         shard_opt_state=bool(shard.get("opt_state", base.shard_opt_state)),
-        remat=bool(raw.get("remat", base.remat)),
+        remat=_normalize_remat_field(raw.get("remat", base.remat)),
     )
 
 

@@ -135,7 +135,9 @@ def _run_benchmark_impl(
         # TPU compiles all of these compositions.
         import os as _os
 
-        workaround = "all-reduce-promotion" in _os.environ.get("XLA_FLAGS", "")
+        from ..utils.platform import allreduce_promotion_disabled
+
+        workaround = allreduce_promotion_disabled(_os.environ.get("XLA_FLAGS", ""))
         if not (workaround and dp == 1):
             raise ValueError(
                 "pipeline_parallel x tensor_parallel on the CPU backend needs "
@@ -182,6 +184,15 @@ def _run_benchmark_impl(
     # v5e chip) — refuse with a breakdown instead of an allocator OOM mid-run.
     from ..utils import memory as memory_mod
     from .step import _resolve_model_config
+
+    if strategy.remat == "auto":
+        strategy = memory_mod.resolve_auto_remat(
+            _resolve_model_config(model_config, strategy, mesh), strategy, mesh,
+            per_device_batch, seq_len, dataset_size=dataset_size,
+            device_kind=devices[0].device_kind,
+        )
+        if is_main:
+            print(f"Auto remat: resolved to '{strategy.remat}' for this arm")
 
     est = memory_mod.estimate_hbm(
         _resolve_model_config(model_config, strategy, mesh), strategy, mesh,
@@ -306,14 +317,18 @@ def _run_benchmark_impl(
 
     dist.barrier()
 
-    # Fetch the step executable for XLA's measured memory accounting
-    # (measure_peak_hbm rung 2). Cache hit after the run — costs <1ms.
-    try:
-        compiled_step = state.aot_compile(params, opt_state, table, 0)
-    except Exception as e:  # degrade down the fallback chain, never fail a run
-        compiled_step = None
-        if is_main:
-            print(f"WARNING: step AOT compile for memory accounting failed: {e}")
+    # Fetch the step executable for XLA's measured memory accounting — only
+    # needed when the allocator can't report a peak itself (measure_peak_hbm
+    # rung 2). Cache hit after the run — costs <1ms on this jit cache; the
+    # guard avoids even that (and any cache-miss recompile) on runtimes
+    # whose memory_stats() works.
+    compiled_step = None
+    if metrics_mod.peak_hbm_bytes() is None:
+        try:
+            compiled_step = state.aot_compile(params, opt_state, table, 0)
+        except Exception as e:  # degrade down the fallback chain, never fail a run
+            if is_main:
+                print(f"WARNING: step AOT compile for memory accounting failed: {e}")
 
     result = metrics_mod.compute_result(
         strategy=strategy.name,
@@ -334,12 +349,14 @@ def _run_benchmark_impl(
         flops_per_token=flops_mod.train_flops_per_token(model_config),
         est_hbm_gb=round(est.total / 1e9, 3),  # decimal GB, same unit as peak_hbm_gb
         compiled_step=compiled_step,
+        sync_every=sync_every,
         tensor_parallel=tp,
         sequence_parallel=sp,
         pipeline_parallel=pp,
         pipeline_schedule=pipeline_schedule,
         expert_parallel=ep,
         n_experts=n_experts,
+        remat_policy=state.model_config.remat,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

@@ -74,8 +74,12 @@ def _resolve_model_config(
         and _jax.default_backend() == "cpu"
     ):
         compute_dtype = jnp.float32
+    # "auto" is resolved against the memory model by the benchmark loop
+    # (utils.memory.resolve_auto_remat); a direct create_train_state caller
+    # that skips that step gets the conservative policy.
+    remat = "full" if strategy.remat == "auto" else strategy.remat
     return dataclasses.replace(
-        model_config, remat=strategy.remat, compute_dtype=compute_dtype
+        model_config, remat=remat, compute_dtype=compute_dtype
     )
 
 

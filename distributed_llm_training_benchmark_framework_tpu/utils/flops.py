@@ -54,6 +54,47 @@ def device_peak_tflops(device_kind: str) -> Optional[float]:
     return None
 
 
+# Public on-demand US-region list prices, USD per chip-hour (Cloud TPU pricing
+# page, mid-2025; multi-chip pod types priced per chip). The reference's
+# cost-efficiency metric (reference README.md:270-276) uses its cloud's A10
+# on-demand rate the same way. Same substring-match convention as the peak
+# table; order matters.
+_ONDEMAND_USD_PER_CHIP_HR = (
+    ("TPU v6 lite", 2.70),  # Trillium / v6e
+    ("TPU v6", 2.70),
+    ("TPU v5 lite", 1.20),  # v5e
+    ("TPU v5e", 1.20),
+    ("TPU v5p", 4.20),
+    ("TPU v5", 4.20),
+    ("TPU v4", 3.22),
+    ("TPU v3", 2.00),
+    ("TPU v2", 1.125),
+)
+
+
+def device_usd_per_chip_hour(device_kind: str) -> Optional[float]:
+    """On-demand $/chip-hour for a device kind, or None if unknown (CPU)."""
+    for name, price in _ONDEMAND_USD_PER_CHIP_HR:
+        if name.lower() in device_kind.lower():
+            return price
+    return None
+
+
+def tokens_per_dollar(
+    tokens_per_sec_per_chip: float, device_kind: str
+) -> Optional[float]:
+    """Training cost efficiency: tokens processed per on-demand dollar.
+
+    The reference publishes this per arm (reference README.md:270-276,
+    tokens/$ at the A10's hourly rate); computed here from the same
+    per-chip throughput the rest of the metric surface uses.
+    """
+    price = device_usd_per_chip_hour(device_kind)
+    if price is None or tokens_per_sec_per_chip <= 0:
+        return None
+    return tokens_per_sec_per_chip * 3600.0 / price
+
+
 def forward_flops_per_token(config) -> float:
     """Analytic forward-pass FLOPs per token for a TinyGPTConfig."""
     D, L, V, S = config.n_embd, config.n_layer, config.vocab_size, config.block_size

@@ -65,12 +65,18 @@ def device_hbm_bytes(device_kind: str) -> Optional[int]:
 def _sharded_bytes(shapes, specs, mesh) -> int:
     """Total bytes of a shape-tree, each leaf divided by its shard factor."""
     total = 0
-    for shape_leaf, spec_leaf in zip(
-        jax.tree_util.tree_leaves(shapes),
-        jax.tree_util.tree_leaves(
-            specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
-        ),
-    ):
+    shape_leaves = jax.tree_util.tree_leaves(shapes)
+    spec_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+    )
+    if len(shape_leaves) != len(spec_leaves):
+        # A silent zip-truncation here would under-estimate HBM and defeat
+        # the fail-fast pre-flight check — structure drift must fail loudly.
+        raise ValueError(
+            f"shape tree has {len(shape_leaves)} leaves but spec tree has "
+            f"{len(spec_leaves)}; the trees must mirror each other"
+        )
+    for shape_leaf, spec_leaf in zip(shape_leaves, spec_leaves):
         nbytes = int(np.prod(shape_leaf.shape) or 1) * shape_leaf.dtype.itemsize
         factor = 1
         if isinstance(spec_leaf, jax.sharding.PartitionSpec):
@@ -155,8 +161,18 @@ def estimate_hbm(
         # scores + probs materialize per head, fp32 softmax: the O(S^2) term.
         dense_per_layer += 2 * B * (H // max(tp, 1)) * S * S * 4
     layers_here = L // max(pp, 1)
-    if cfg.remat:
+    from ..models.tinygpt import normalize_remat
+
+    pol = normalize_remat("full" if cfg.remat == "auto" else cfg.remat)
+    if pol == "full":
+        # Only the layer-boundary residual (+grad) survives; one layer's
+        # working set is live during its backward recompute.
         act_b = layers_here * 2 * B * S * D * cbytes + dense_per_layer
+    elif pol == "dots":
+        # Matmul outputs are saved (~qkv 3BSD + attn-out BSD + mlp 5BSD +
+        # boundary 2BSD ≈ 11·BSD per layer); elementwise intermediates are
+        # recomputed within one layer's working set.
+        act_b = layers_here * 11 * B * S * D * cbytes + dense_per_layer
     else:
         act_b = layers_here * dense_per_layer
     # fp32 logits + cotangent at the LM head.
@@ -214,3 +230,39 @@ def check_fits(
         f"{cap / 1024**3:.0f} GiB on {device_kind} "
         f"(margin {margin:.0%}).{hint}\n{format_breakdown(est, device_kind)}"
     )
+
+
+def resolve_auto_remat(
+    model_config: Any,
+    strategy: Any,
+    mesh: Any,
+    per_device_batch: int,
+    seq_len: int,
+    dataset_size: int = 0,
+    device_kind: str = "",
+) -> Any:
+    """Resolve a strategy's remat="auto" to the cheapest policy that fits.
+
+    Tries "none" -> "dots" -> "full" against :func:`estimate_hbm` +
+    :func:`check_fits` for this arm's actual (batch, seq, mesh) geometry.
+    Remat trades recompute for memory; paying the tax when the arm already
+    fits measured ~20% of zero3's single-chip throughput (docs/PERFORMANCE
+    .md), so the tax is only paid under actual memory pressure. Returns the
+    strategy unchanged unless remat == "auto". Unknown device kinds (CPU)
+    are never refused by check_fits, so they resolve to "none".
+    """
+    import dataclasses as _dc
+
+    if getattr(strategy, "remat", None) != "auto":
+        return strategy
+    for pol in ("none", "dots", "full"):
+        cand = _dc.replace(strategy, remat=pol)
+        cfg = _dc.replace(model_config, remat=pol)
+        est = estimate_hbm(
+            cfg, cand, mesh, per_device_batch, seq_len, dataset_size=dataset_size
+        )
+        if check_fits(est, device_kind) is None:
+            return cand
+    # Nothing fits; return the most memory-frugal policy and let the
+    # pre-flight check downstream produce the refusal message.
+    return _dc.replace(strategy, remat="full")

@@ -145,12 +145,29 @@ class BenchmarkResult:
     flops_per_token: float = 0.0
     model_tflops_per_sec_per_chip: float = 0.0
     mfu_pct: float = 0.0  # 0.0 when the device kind's peak is unknown (CPU)
+    # Cost efficiency at public on-demand $/chip-hr (utils.flops price table);
+    # 0.0 for unknown device kinds. Reference parity: README.md:270-276.
+    usd_per_chip_hour: float = 0.0
+    tokens_per_dollar: float = 0.0
+    # Per-step wall-time distribution over the timed (post-warmup) steps.
+    # Individually meaningful when sync_every == 1 (each step fenced, the
+    # reference's per-step loss.item() discipline); with sync_every > 1 each
+    # step carries its window's mean, so the spread understates true variance
+    # — consumers must check sync_every before using these.
+    sync_every: int = 1
+    step_time_p50_sec: float = 0.0
+    step_time_p95_sec: float = 0.0
+    step_time_max_sec: float = 0.0
+    step_time_cv_pct: float = 0.0  # stddev / mean * 100
     tensor_parallel: int = 1
     sequence_parallel: int = 1
     pipeline_parallel: int = 1
     pipeline_schedule: str = "gpipe"  # meaningful when pipeline_parallel > 1
     expert_parallel: int = 1
     n_experts: int = 0
+    # The remat policy the run actually executed with ("none"/"dots"/"full")
+    # — provenance for strategies whose "auto" resolves per-geometry.
+    remat_policy: str = "none"
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -182,12 +199,14 @@ def compute_result(
     flops_per_token: float = 0.0,
     est_hbm_gb: float = 0.0,
     compiled_step=None,
+    sync_every: int = 1,
     tensor_parallel: int = 1,
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
     pipeline_schedule: str = "gpipe",
     expert_parallel: int = 1,
     n_experts: int = 0,
+    remat_policy: str = "none",
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
@@ -209,6 +228,18 @@ def compute_result(
     tps_per_chip = tps / world_size if world_size else 0.0
     tflops_per_chip = flops_mod.achieved_tflops_per_sec(tps_per_chip, flops_per_token)
     mfu = flops_mod.mfu_pct(tps_per_chip, flops_per_token, device_kind)
+    price = flops_mod.device_usd_per_chip_hour(device_kind)
+    tok_per_usd = flops_mod.tokens_per_dollar(tps_per_chip, device_kind)
+    if step_times:
+        ts = sorted(step_times)
+        n = len(ts)
+        p50 = ts[n // 2]
+        p95 = ts[min(n - 1, int(0.95 * (n - 1) + 0.5))]
+        t_max = ts[-1]
+        var = sum((t - mean_step) ** 2 for t in step_times) / n
+        cv = 100.0 * var**0.5 / mean_step if mean_step > 0 else 0.0
+    else:
+        p50 = p95 = t_max = cv = 0.0
     return BenchmarkResult(
         strategy=strategy,
         world_size=world_size,
@@ -234,12 +265,20 @@ def compute_result(
         flops_per_token=flops_per_token,
         model_tflops_per_sec_per_chip=tflops_per_chip,
         mfu_pct=mfu if mfu is not None else 0.0,
+        usd_per_chip_hour=price if price is not None else 0.0,
+        tokens_per_dollar=tok_per_usd if tok_per_usd is not None else 0.0,
+        sync_every=sync_every,
+        step_time_p50_sec=p50,
+        step_time_p95_sec=p95,
+        step_time_max_sec=t_max,
+        step_time_cv_pct=cv,
         tensor_parallel=tensor_parallel,
         sequence_parallel=sequence_parallel,
         pipeline_parallel=pipeline_parallel,
         pipeline_schedule=pipeline_schedule,
         expert_parallel=expert_parallel,
         n_experts=n_experts,
+        remat_policy=remat_policy,
     )
 
 
@@ -261,6 +300,17 @@ def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True)
             f"  (MFU {result.mfu_pct:.1f}%)"
         )
     print(f"  Mean step time:   {result.mean_step_time_sec:.4f}s")
+    if result.sync_every == 1 and result.step_time_p95_sec > 0:
+        print(
+            f"  Step time p50/p95/max: {result.step_time_p50_sec:.4f}s /"
+            f" {result.step_time_p95_sec:.4f}s / {result.step_time_max_sec:.4f}s"
+            f"  (cv {result.step_time_cv_pct:.1f}%)"
+        )
+    if result.tokens_per_dollar > 0:
+        print(
+            f"  Tokens/$:         {result.tokens_per_dollar:,.0f}"
+            f"  (at ${result.usd_per_chip_hour:.2f}/chip-hr on-demand)"
+        )
     print(
         f"  Peak HBM/chip:    {result.peak_hbm_gb:.2f} GB"
         f" ({result.peak_hbm_method})"

@@ -35,3 +35,19 @@ def honor_jax_platforms_env() -> None:
             clear_backends()
     except Exception:
         pass
+
+
+def allreduce_promotion_disabled(flags: str) -> bool:
+    """True iff an ``--xla_disable_hlo_passes`` list in ``flags`` names the
+    all-reduce-promotion pass.
+
+    A plain substring test would be satisfied by the string appearing inside
+    any unrelated flag value; this parses the actual pass list (last
+    occurrence wins, matching XLA's flag parsing).
+    """
+    disabled = False
+    for tok in flags.split():
+        if tok.startswith("--xla_disable_hlo_passes="):
+            passes = tok.split("=", 1)[1].split(",")
+            disabled = "all-reduce-promotion" in (p.strip() for p in passes)
+    return disabled

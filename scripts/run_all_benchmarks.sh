@@ -145,5 +145,11 @@ python -m distributed_llm_training_benchmark_framework_tpu.analysis.make_report 
   --csv "$SUMMARY/metrics.csv" --out "$SUMMARY" --plots-dir ../plots
 
 echo ""
+echo "=== Validation (sanity envelopes, results/example_output/README.md) ==="
+python -m distributed_llm_training_benchmark_framework_tpu.analysis.validate_results \
+  --results-dir "$RESULTS_DIR" --logs-dir "$RESULTS_DIR" \
+  || { echo "VALIDATION FAILED"; FAIL=$((FAIL+1)); }
+
+echo ""
 echo "=== Suite complete: $PASS passed, $FAIL failed, $(( $(date +%s) - SUITE_START ))s total ==="
 [ "$FAIL" -eq 0 ]

@@ -141,3 +141,91 @@ def test_duplicate_results_deduped(tmp_path):
 def test_empty_results_dir_errors(tmp_path):
     with pytest.raises(SystemExit):
         parse_metrics.load_results(str(tmp_path))
+
+
+# --- validate_results: the sanity envelopes as executable checks ---
+
+from distributed_llm_training_benchmark_framework_tpu.analysis import (  # noqa: E402
+    validate_results as vr,
+)
+
+
+def test_validate_results_pass(tmp_path):
+    write_results(tmp_path, [
+        result(ws=1, tps=1000.0, sync_every=1, step_time_cv_pct=3.0,
+               peak_hbm_gb=8.0, peak_hbm_method="xla_buffer_assignment",
+               est_hbm_gb=7.0, device_kind="TPU v5 lite"),
+    ])
+    failures, n = vr.collect(str(tmp_path), None)
+    assert n == 1
+    assert failures == []
+
+
+def test_validate_results_loss_envelope(tmp_path):
+    write_results(tmp_path, [result(mean_loss=float(11.5))])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert any("mean_loss" in f for f in failures)
+
+
+def test_validate_results_step_variance_envelope(tmp_path):
+    write_results(tmp_path, [
+        result(sync_every=1, step_time_cv_pct=25.0),
+    ])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert any("cv" in f for f in failures)
+    # Windowed timing: per-step variance unobservable, envelope not applied.
+    write_results(tmp_path, [
+        result(sync_every=10, step_time_cv_pct=25.0),
+    ])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("cv" in f for f in failures)
+
+
+def test_validate_results_memory_envelopes(tmp_path):
+    # est vs measured disagreement beyond tolerance
+    write_results(tmp_path, [
+        result(peak_hbm_gb=10.0, peak_hbm_method="allocator", est_hbm_gb=2.0,
+               device_kind="TPU v5 lite"),
+    ])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert any("analytic est" in f for f in failures)
+    # capacity violation
+    write_results(tmp_path, [
+        result(peak_hbm_gb=99.0, peak_hbm_method="allocator", est_hbm_gb=99.0,
+               device_kind="TPU v5 lite"),
+    ])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert any("exceeds" in f for f in failures)
+
+
+def test_validate_results_marker_contract(tmp_path):
+    write_results(tmp_path, [result()])
+    good = tmp_path / "good.log"
+    good.write_text(
+        "noise\nBENCHMARK_RESULT_JSON_START\n{\"a\": 1}\nBENCHMARK_RESULT_JSON_END\n"
+    )
+    bad = tmp_path / "bad.log"
+    bad.write_text("no markers here\n")
+    failures, n = vr.collect(str(tmp_path), str(tmp_path))
+    assert any("bad.log" in f for f in failures)
+    assert not any("good.log" in f for f in failures)
+
+
+def test_validate_results_cli_exit_codes(tmp_path):
+    write_results(tmp_path, [result()])
+    assert vr.main(["--results-dir", str(tmp_path)]) == 0
+    write_results(tmp_path, [result(tokens_per_sec=0.0)])
+    assert vr.main(["--results-dir", str(tmp_path)]) == 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert vr.main(["--results-dir", str(empty)]) == 1
+
+
+def test_report_cost_efficiency_finding(tmp_path):
+    df = pd.DataFrame([
+        result(ws=1, tps=42000.0, tokens_per_dollar=1.26e8,
+               usd_per_chip_hour=1.20, scaling_efficiency_pct=100.0),
+    ])
+    text = make_report.build_report(df)
+    assert "Best cost efficiency" in text
+    assert "tokens/$" in text

@@ -33,6 +33,7 @@ def run(state, params, opt, steps, start=0):
     return params, opt, losses
 
 
+@pytest.mark.slow
 def test_save_restore_roundtrip_sharded(tmp_path, eight_devices):
     state = make_state("fsdp")
     params, opt, _ = run(state, state.params, state.opt_state, 2)
@@ -49,6 +50,7 @@ def test_save_restore_roundtrip_sharded(tmp_path, eight_devices):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_resume_continues_identically(tmp_path, eight_devices):
     """train 4 steps straight == train 2, checkpoint, restore, train 2 more."""
     s1 = make_state("zero2")

@@ -69,6 +69,7 @@ def two_process_run(tmp_path_factory):
     return outs, results
 
 
+@pytest.mark.slow
 def test_both_ranks_exit_zero(two_process_run):
     outs, _ = two_process_run
     for rank, (rc, out, err) in enumerate(outs):

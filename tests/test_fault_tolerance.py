@@ -9,6 +9,10 @@ checkpointed run, then restarted with --resume, and must complete with the
 result markers intact.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import os
 import signal
 import subprocess

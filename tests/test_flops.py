@@ -75,3 +75,16 @@ def test_compute_result_carries_flops_fields():
         device_kind="cpu", backend="cpu", flops_per_token=1.8e9,
     )
     assert cpu.mfu_pct == 0.0
+
+
+def test_tokens_per_dollar():
+    import pytest
+    from distributed_llm_training_benchmark_framework_tpu.utils import flops
+
+    assert flops.device_usd_per_chip_hour("TPU v5 lite") == 1.20
+    assert flops.device_usd_per_chip_hour("cpu") is None
+    # 42k tok/s on v5e at $1.20/hr -> 126M tokens/$
+    tpd = flops.tokens_per_dollar(42000.0, "TPU v5 lite")
+    assert tpd == pytest.approx(42000.0 * 3600 / 1.2)
+    assert flops.tokens_per_dollar(42000.0, "cpu") is None
+    assert flops.tokens_per_dollar(0.0, "TPU v5 lite") is None

@@ -19,6 +19,8 @@ import textwrap
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 FAKE_KUBECTL = r'''#!/usr/bin/env python3

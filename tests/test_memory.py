@@ -1,6 +1,8 @@
 """HBM footprint estimator (utils.memory): exact param accounting, sharding
 divisors, and the tier-B refusal the round-1 verdict asked for."""
 
+import dataclasses
+
 import jax
 import numpy as np
 
@@ -107,3 +109,41 @@ def test_measure_peak_hbm_fallback_chain():
     # Rung ordering: without an executable we degrade, never raise.
     gb2, method2 = m.measure_peak_hbm(None)
     assert method2 in ("allocator", "live_arrays", "unavailable")
+
+
+def test_resolve_auto_remat_no_pressure_picks_none():
+    from distributed_llm_training_benchmark_framework_tpu.utils.memory import (
+        resolve_auto_remat,
+    )
+
+    strat = dataclasses.replace(get_strategy("zero3"))
+    assert strat.remat == "auto"
+    cfg = get_model_config("A", 2048, attention_impl="flash")
+    out = resolve_auto_remat(
+        cfg, strat, _mesh(), 1, 2048, device_kind="TPU v5 lite"
+    )
+    assert out.remat == "none"  # tier A flash fits a v5e without remat
+
+
+def test_resolve_auto_remat_under_pressure_escalates():
+    from distributed_llm_training_benchmark_framework_tpu.utils.memory import (
+        resolve_auto_remat,
+    )
+
+    strat = get_strategy("zero3")
+    cfg = get_model_config("A", 8192, attention_impl="flash")
+    # batch 8 @ seq 8192: activations dominate; "none" cannot fit 16 GiB.
+    out = resolve_auto_remat(
+        cfg, strat, _mesh(), 8, 8192, device_kind="TPU v5 lite"
+    )
+    assert out.remat in ("dots", "full")
+
+
+def test_resolve_auto_remat_passthrough_non_auto():
+    from distributed_llm_training_benchmark_framework_tpu.utils.memory import (
+        resolve_auto_remat,
+    )
+
+    strat = get_strategy("ddp")
+    cfg = get_model_config("A", 2048)
+    assert resolve_auto_remat(cfg, strat, _mesh(), 1, 2048) is strat

@@ -134,17 +134,20 @@ def test_dropout_rng_determinism():
     assert float(l1) != float(l3)
 
 
-def test_remat_matches_no_remat():
+@pytest.mark.parametrize("policy", ["full", True, "dots"])
+def test_remat_matches_no_remat(policy):
+    """Every remat policy (incl. the legacy bool spelling) is semantically
+    a no-op — same loss, same gradients up to recompute rounding."""
     cfg = small_cfg()
     params = init_params(cfg, jax.random.key(0))
     idx = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg.vocab_size)
     import dataclasses
 
     l_plain = loss_fn(cfg, params, idx, idx)
-    l_remat = loss_fn(dataclasses.replace(cfg, remat=True), params, idx, idx)
+    l_remat = loss_fn(dataclasses.replace(cfg, remat=policy), params, idx, idx)
     g_plain = jax.grad(lambda p: loss_fn(cfg, p, idx, idx))(params)
     g_remat = jax.grad(
-        lambda p: loss_fn(dataclasses.replace(cfg, remat=True), p, idx, idx)
+        lambda p: loss_fn(dataclasses.replace(cfg, remat=policy), p, idx, idx)
     )(params)
     assert np.allclose(float(l_plain), float(l_remat), rtol=1e-5)
     for a, b in zip(jax.tree_util.tree_leaves(g_plain), jax.tree_util.tree_leaves(g_remat)):

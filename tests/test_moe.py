@@ -96,6 +96,7 @@ def test_expert_parallel_sharding(eight_devices):
     assert r.sharding.shard_shape(r.shape) == r.shape
 
 
+@pytest.mark.slow
 def test_ep_trajectory_matches_single_device(eight_devices):
     """Expert parallelism must not change the computation."""
 

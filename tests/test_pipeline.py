@@ -21,6 +21,7 @@ from distributed_llm_training_benchmark_framework_tpu.train import create_train_
 from distributed_llm_training_benchmark_framework_tpu.data import SyntheticDataset
 
 
+@pytest.mark.slow
 def test_pipeline_loss_matches_plain_forward(eight_devices):
     """The GPipe schedule computes exactly the plain forward's mean loss."""
     cfg = get_model_config("S", 64, dropout=0.0)  # 2 layers -> 2 stages
@@ -37,6 +38,7 @@ def test_pipeline_loss_matches_plain_forward(eight_devices):
     np.testing.assert_allclose(float(pl_loss), plain, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_1f1b_loss_and_grads_match_autodiff_gpipe(eight_devices):
     """The hand-scheduled 1F1B backward produces the same loss AND gradients
     as autodiff over the GPipe schedule (same math, different schedule)."""
@@ -74,6 +76,7 @@ def test_1f1b_loss_and_grads_match_autodiff_gpipe(eight_devices):
         )
 
 
+@pytest.mark.slow
 def test_1f1b_with_dropout_matches_gpipe(eight_devices):
     """With live dropout keys, the 1F1B recompute replays the forward's masks
     (tick-derived keys), so loss still matches GPipe exactly."""
@@ -135,12 +138,14 @@ def run_steps(state, n_steps, dp, grad_accum, seq=64):
     return losses
 
 
+@pytest.mark.slow
 def test_pp_trajectory_matches_ddp(eight_devices):
     base = run_steps(make_state("ddp", (2, 1, 1, 1), 4), 3, dp=2, grad_accum=4)
     pp = run_steps(make_state("ddp", (2, 1, 1, 2), 4), 3, dp=2, grad_accum=4)
     np.testing.assert_allclose(pp, base, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_1f1b_trajectory_matches_gpipe(eight_devices):
     """End-to-end train steps: 1F1B and GPipe walk the same loss trajectory
     (composed with dp=2 to exercise the mixed manual/auto axes)."""
@@ -152,6 +157,7 @@ def test_1f1b_trajectory_matches_gpipe(eight_devices):
     np.testing.assert_allclose(f1b, gpipe, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_pp_composes_with_tp_subprocess():
     """tp=2 x pp=2 trajectory parity vs plain ddp, in a subprocess with
     XLA_FLAGS=--xla_disable_hlo_passes=all-reduce-promotion.

@@ -56,12 +56,14 @@ def test_tp_param_layout(eight_devices):
     assert np.prod(w.sharding.shard_shape(w.shape)) == np.prod(w.shape) // 8
 
 
+@pytest.mark.slow
 def test_tp_matches_ddp_trajectory(eight_devices):
     base = run_steps(make_state("ddp", (4, 1, 1)), 3, dp=4)
     tp = run_steps(make_state("ddp", (4, 1, 2)), 3, dp=4)
     np.testing.assert_allclose(tp, base, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_fsdp_composes_with_tp(eight_devices):
     """2-D mesh: 'data' sharding lands on a different axis than 'model'."""
     state = make_state("fsdp", (4, 1, 2))
@@ -73,12 +75,14 @@ def test_fsdp_composes_with_tp(eight_devices):
     np.testing.assert_allclose(mixed, base, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_sp_ring_matches_ddp_trajectory(eight_devices):
     base = run_steps(make_state("ddp", (2, 1, 1)), 3, dp=2)
     sp = run_steps(make_state("ddp", (2, 4, 1), attention="ring"), 3, dp=2)
     np.testing.assert_allclose(sp, base, rtol=5e-3)
 
 
+@pytest.mark.slow
 def test_dp_sp_tp_all_at_once(eight_devices):
     """The full 3-D mesh: 2-way data x 2-way sequence x 2-way tensor."""
     base = run_steps(make_state("zero2", (2, 1, 1)), 3, dp=2)
@@ -86,6 +90,7 @@ def test_dp_sp_tp_all_at_once(eight_devices):
     np.testing.assert_allclose(full, base, rtol=5e-3)
 
 
+@pytest.mark.slow
 def test_sp_ulysses_matches_ddp_trajectory(eight_devices):
     """All-to-all (Ulysses) sequence parallelism walks the same trajectory as
     plain ddp — same bar as the ring variant, different comm pattern."""
@@ -94,6 +99,7 @@ def test_sp_ulysses_matches_ddp_trajectory(eight_devices):
     np.testing.assert_allclose(sp, base, rtol=5e-3)
 
 
+@pytest.mark.slow
 def test_dp_sp_ulysses_tp(eight_devices):
     """Ulysses composes with data + tensor parallelism (local heads H/tp
     must still divide the seq axis: tier S has 4 heads, tp=2 -> 2, sp=2 ok)."""
