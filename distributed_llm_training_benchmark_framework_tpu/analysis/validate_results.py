@@ -46,6 +46,10 @@ STEP_CV_LIMIT_PCT = 10.0
 # utils/memory.py's documented accuracy claim for the analytic model,
 # validated here against the measured column whenever one exists.
 EST_VS_MEASURED_TOL = 0.35
+# ...but only at benchmark scale: below this floor (tier-S CPU smoke runs,
+# tens of MB) the analytic model's ignored constants (runtime buffers,
+# padding) dominate and a relative band is meaningless.
+EST_VS_MEASURED_MIN_GB = 1.0
 
 
 def _check(ok: bool, label: str, detail: str, failures: List[str]) -> None:
@@ -70,7 +74,9 @@ def validate_result(r: dict, name: str) -> List[str]:
            f"mean_step_time_sec={r['mean_step_time_sec']} (must be > 0)", f)
 
     loss = r["mean_loss"]
-    vocab = 32000  # TinyGPT tiers share the reference vocab
+    # Reference tiers A/B share the 32000 vocab; tier S (CPU smoke) is 512 —
+    # its random-init ceiling is ~4.6 nats lower (tinygpt.get_model_config).
+    vocab = 512 if r.get("tier") == "S" else 32000
     ceil = math.log(vocab) + LOSS_CEIL_SLACK
     _check(
         LOSS_FLOOR < loss < ceil, name,
@@ -89,7 +95,11 @@ def validate_result(r: dict, name: str) -> List[str]:
     est = r.get("est_hbm_gb", 0.0)
     measured = r.get("peak_hbm_gb", 0.0)
     method = r.get("peak_hbm_method", "unavailable")
-    if est > 0 and measured > 0 and method in ("allocator", "xla_buffer_assignment"):
+    if (
+        est > 0
+        and measured >= EST_VS_MEASURED_MIN_GB
+        and method in ("allocator", "xla_buffer_assignment")
+    ):
         rel = abs(measured - est) / measured
         _check(
             rel <= EST_VS_MEASURED_TOL, name,

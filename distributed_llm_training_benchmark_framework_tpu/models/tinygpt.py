@@ -99,6 +99,13 @@ class TinyGPTConfig:
     # (16x the HLO, but activations save as distinct buffers instead of
     # dynamic-update-slice stacking — a tuning surface for single-chip runs).
     scan_layers: bool = True
+    # Set (to the mesh axis name, e.g. 'seq') by the pipeline schedules when
+    # they run their shard_map manually over the sequence axis: activations
+    # then carry LOCAL sequence chunks, attention dispatches to the
+    # *_sharded ring/Ulysses bodies (which communicate over this axis), the
+    # positional embedding is offset by the shard index, and per-shard
+    # dropout streams are decorrelated. None = ordinary (auto/GSPMD) mode.
+    seq_manual_axis: Optional[str] = None
     # Mixture-of-Experts MLP (0 = dense). When > 0 every block's MLP becomes
     # a top-k routed expert layer (models.moe) and the training loss gains
     # the Switch load-balance auxiliary term.
@@ -263,6 +270,38 @@ def _attention(
     seed = None
     if not deterministic and config.dropout > 0.0 and dropout_key is not None:
         seed = jax.random.bits(dropout_key, (), jnp.uint32)
+    if config.seq_manual_axis is not None:
+        # Inside a shard_map that is manual over the sequence axis (the
+        # pipeline schedules): q/k/v hold LOCAL sequence chunks, so dispatch
+        # straight to the sharded attention bodies, which communicate over
+        # that axis. The dropout seed is deliberately NOT per-shard here —
+        # ring masks are keyed by global coordinates (all ring participants
+        # must agree on the seed); Ulysses folds its own shard index.
+        ax = config.seq_manual_axis
+        if config.attention_impl == "ring":
+            from ..ops.ring_attention import ring_attention_sharded
+
+            return ring_attention_sharded(
+                q, k, v, axis_name=ax, causal=config.causal,
+                dropout_rate=config.dropout if seed is not None else 0.0,
+                dropout_seed=seed,
+            )
+        if config.attention_impl == "ulysses":
+            from ..ops.ulysses_attention import ulysses_attention_sharded
+
+            return ulysses_attention_sharded(
+                q, k, v, axis_name=ax, causal=config.causal,
+                dropout_rate=config.dropout if seed is not None else 0.0,
+                dropout_seed=seed,
+                block_q=config.flash_block_q, block_k=config.flash_block_k,
+                block_k_bwd=config.flash_block_k_bwd,
+                pallas_backward=config.flash_pallas_backward,
+            )
+        raise ValueError(
+            "sequence-parallel pipeline needs attention_impl 'ring' or "
+            f"'ulysses' (local '{config.attention_impl}' attention over a "
+            "sequence chunk would silently compute blockwise attention)"
+        )
     if config.attention_impl == "flash":
         # Pallas TPU kernel; fp32 online-softmax accumulation internally.
         from ..ops.flash_attention import flash_attention
@@ -328,6 +367,11 @@ def _block(
     keys = (
         jax.random.split(dropout_key, 2) if dropout_key is not None else (None, None)
     )
+    if keys[1] is not None and c.seq_manual_axis is not None:
+        # Sequence shards hold different token positions: decorrelate the
+        # (materialized-mask) MLP dropout stream per shard. The attention key
+        # keys[0] stays shared — ring/Ulysses handle their own coordinates.
+        keys = (keys[0], jax.random.fold_in(keys[1], lax.axis_index(c.seq_manual_axis)))
 
     # --- attention sublayer ---
     h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
@@ -377,11 +421,23 @@ def embed(
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
 ) -> jax.Array:
-    """Token + positional embedding -> dropout -> (B, S, D) compute dtype."""
+    """Token + positional embedding -> dropout -> (B, S, D) compute dtype.
+
+    Under a sequence-manual pipeline (``config.seq_manual_axis``), ``idx`` is
+    this shard's chunk of the sequence: the positional table is sliced at the
+    shard's global offset and the embedding-dropout stream is decorrelated
+    per shard.
+    """
     c = config
     S = idx.shape[1]
     tok = jnp.take(params["wte"], idx, axis=0)
-    pos = params["wpe"][:S]
+    if c.seq_manual_axis is not None:
+        shard = lax.axis_index(c.seq_manual_axis)
+        pos = lax.dynamic_slice_in_dim(params["wpe"], shard * S, S, axis=0)
+        if dropout_key is not None:
+            dropout_key = jax.random.fold_in(dropout_key, shard)
+    else:
+        pos = params["wpe"][:S]
     x = (tok + pos[None, :, :]).astype(c.compute_dtype)
     if dropout_key is not None and not deterministic:
         x = _dropout(x, c.dropout, dropout_key, deterministic)
@@ -420,9 +476,17 @@ def apply_blocks(
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         )
 
+    # Inside a partially-manual shard_map (the pipeline), x is varying over
+    # the manual axes; the scalar aux carry must match that type or the scan
+    # rejects the carry (invariant in, varying out after the first MoE add).
+    def _aux0():
+        z = jnp.zeros((), jnp.float32)
+        vma = getattr(jax.typeof(x), "vma", ())
+        return lax.pcast(z, tuple(vma), to="varying") if vma else z
+
     if not c.scan_layers:
         n_local = jax.tree_util.tree_leaves(blocks)[0].shape[0]
-        aux = jnp.zeros((), jnp.float32)
+        aux = _aux0()
         live = base_key is not None and not deterministic
         for i in range(n_local):
             layer = jax.tree_util.tree_map(lambda t: t[i], blocks)
@@ -439,7 +503,7 @@ def apply_blocks(
             x, a = block(x, layer, None)
             return (x, aux + a), None
 
-        (x, aux), _ = lax.scan(scan_body, (x, jnp.zeros((), jnp.float32)), blocks)
+        (x, aux), _ = lax.scan(scan_body, (x, _aux0()), blocks)
     else:
         n_local = jax.tree_util.tree_leaves(blocks)[0].shape[0]
         idxs = jnp.arange(n_local) + layer_offset
@@ -450,7 +514,7 @@ def apply_blocks(
             return (x, aux + a), None
 
         (x, aux), _ = lax.scan(
-            scan_body, (x, jnp.zeros((), jnp.float32)), (blocks, idxs)
+            scan_body, (x, _aux0()), (blocks, idxs)
         )
     return x, aux
 
@@ -507,9 +571,12 @@ def forward(
     return logits, loss
 
 
-def _cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
-    """Mean CE over positions where target != -1 (parity: ignore_index=-1,
-    reference train_harness.py:98-103)."""
+def _cross_entropy_parts(
+    logits: jax.Array, targets: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """(nll_sum, valid_count) over positions where target != -1 — the
+    unreduced halves of the mean CE, so sequence-parallel callers can psum
+    both across shards before dividing."""
     V = logits.shape[-1]
     logits = logits.reshape(-1, V).astype(jnp.float32)
     targets = targets.reshape(-1)
@@ -518,8 +585,21 @@ def _cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
     nll = jnp.where(valid, logz - gold, 0.0)
-    count = jnp.maximum(valid.sum(), 1)
-    return nll.sum() / count
+    return nll.sum(), valid.sum()
+
+
+def _cross_entropy(
+    logits: jax.Array, targets: jax.Array, seq_axis: Optional[str] = None
+) -> jax.Array:
+    """Mean CE over positions where target != -1 (parity: ignore_index=-1,
+    reference train_harness.py:98-103). ``seq_axis`` names a manual mesh axis
+    the positions are sharded over (the sequence-parallel pipeline): sums and
+    counts combine across shards before the divide."""
+    nll_sum, count = _cross_entropy_parts(logits, targets)
+    if seq_axis is not None:
+        nll_sum = lax.psum(nll_sum, seq_axis)
+        count = lax.psum(count, seq_axis)
+    return nll_sum / jnp.maximum(count, 1)
 
 
 def loss_fn(

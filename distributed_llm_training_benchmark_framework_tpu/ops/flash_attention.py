@@ -195,6 +195,63 @@ def _flash_fwd_kernel(
         lse_ref[0] = jnp.broadcast_to(lse[None, :], (8, lse.shape[0]))
 
 
+def _vma_struct(shape, dtype, *like):
+    """ShapeDtypeStruct carrying the union of the inputs' varying-manual-axes.
+
+    When the kernel runs inside a vma-checked ``shard_map`` (e.g. Ulysses
+    under the sequence-manual pipeline), Pallas requires out_shapes to declare
+    how outputs vary across the manual mesh axes — they vary exactly as the
+    operands do (the kernel is pointwise in the shard dimension)."""
+    vma = set()
+    for a in like:
+        vma |= set(getattr(jax.typeof(a), "vma", ()) or ())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _jnp_reference_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool, dropout_rate: float, seed: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Materialized-softmax forward with the kernel's exact mask/accumulation
+    semantics (same ``_dropout_keep`` coordinates, same un-dropped normalizer),
+    for contexts where the Pallas HLO interpreter cannot run — currently
+    vma-carrying manual regions on CPU (the interpreter's internal
+    dynamic_slice rejects mixed varying/invariant operands). Returns
+    (out, lse) exactly as ``_flash_forward`` does."""
+    BH, S, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum(
+        "bqd,bkd->bqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    rows = lax.broadcasted_iota(jnp.int32, (S, 1), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (1, S), 1)
+    if causal:
+        s = jnp.where((rows >= cols)[None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    if causal:
+        p = jnp.where((rows >= cols)[None], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    if dropout_rate > 0.0:
+        bh = jnp.arange(BH, dtype=jnp.uint32)[:, None, None]
+        keep = _dropout_keep(
+            seed[0], bh, rows[None], cols[None], _dropout_threshold(dropout_rate)
+        )
+        p_acc = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+    else:
+        p_acc = p
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    acc = jnp.einsum(
+        "bqk,bkd->bqd", p_acc.astype(q.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    out = (acc / l_safe).astype(q.dtype)
+    lse = (m + jnp.log(l_safe))[:, :, 0]
+    return out, lse
+
+
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool, interpret: bool, bq: int, bk: int,
@@ -206,14 +263,18 @@ def _flash_forward(
     grid = (BH, S // bq, S // bk)
     if seed is None:
         seed = jnp.zeros((1,), jnp.uint32)
+    if interpret and any(
+        getattr(jax.typeof(a), "vma", None) for a in (q, k, v)
+    ):
+        return _jnp_reference_forward(q, k, v, causal, dropout_rate, seed)
     out, lse = pl.pallas_call(
         functools.partial(
             _flash_fwd_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
             seq_len=S, dropout_rate=dropout_rate,
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, 8, S), jnp.float32),
+            _vma_struct((BH, S, D), q.dtype, q, k, v),
+            _vma_struct((BH, 8, S), jnp.float32, q, k, v),
         ],
         grid=grid,
         in_specs=[
@@ -444,6 +505,13 @@ def _jnp_blockwise_bwd(causal, bk, rate, res, do):
         return dq_acc, (dk_b, dv_b)
 
     dq0 = jnp.zeros((BH, S, D), f32)
+    # Under a vma-checked manual region the accumulator carry must match the
+    # varying type the block updates produce.
+    vma = set()
+    for a in (q, k, v, do):
+        vma |= set(getattr(jax.typeof(a), "vma", ()) or ())
+    if vma:
+        dq0 = lax.pcast(dq0, tuple(vma), to="varying")
     dq, (dk_blocks, dv_blocks) = lax.scan(one_block, dq0, (jnp.arange(nk), ks, vs))
     dk = dk_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
     dv = dv_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
@@ -458,6 +526,13 @@ def _flash_bwd_rule(opts, res, do):
     """
     causal, interpret, bq, bk_fwd, bk, pallas_bwd, rate = opts
     seed_ct = np.zeros((1,), jax.dtypes.float0)  # seed is integral: no tangent
+    if pallas_bwd and interpret and any(
+        getattr(jax.typeof(a), "vma", None) for a in res[:3] + (do,)
+    ):
+        # Same limitation the forward's _jnp_reference_forward fallback works
+        # around: the Pallas HLO interpreter cannot run on vma-carrying
+        # operands (seq-manual pipeline on CPU) — take the jnp backward.
+        pallas_bwd = False
     if not pallas_bwd:
         return (*_jnp_blockwise_bwd(causal, bk, rate, res, do), seed_ct)
     q, k, v, out, lse, seed = res
@@ -483,7 +558,7 @@ def _flash_bwd_rule(opts, res, do):
             _bwd_dq_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
             seq_len=S, dropout_rate=rate,
         ),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        out_shape=_vma_struct((BH, S, D), q.dtype, q, k, v, do),
         grid=(BH, S // bq, S // bk),
         in_specs=[seed_spec, row_specs["q"], row_specs["k"], row_specs["k"],
                   row_specs["q"], row_specs["stat"], row_specs["stat"]],
@@ -506,8 +581,8 @@ def _flash_bwd_rule(opts, res, do):
             seq_len=S, dropout_rate=rate,
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            _vma_struct((BH, S, D), k.dtype, q, k, v, do),
+            _vma_struct((BH, S, D), v.dtype, q, k, v, do),
         ],
         grid=(BH, S // bk, S // bq),
         in_specs=[seed_spec, col_specs["q"], col_specs["k"], col_specs["k"],

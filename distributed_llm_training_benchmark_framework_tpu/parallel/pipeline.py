@@ -21,13 +21,16 @@ Implementation notes:
 - microbatches double as gradient accumulation: the step's (accum, batch,
   seq) input feeds the pipeline as its M microbatches.
 
-Constraint: n_layer % pipe == 0; ring (sequence-parallel) attention does not
-compose with the pipeline in this version (nested manual axes) — use
-dp/tp/pp.
+Constraint: n_layer % pipe == 0. Sequence parallelism composes: with a >1
+'seq' mesh axis the schedules go manual over ('pipe', 'seq') and attention
+runs the sharded ring/Ulysses bodies inside each stage (see ``_seq_setup``).
+MoE composes too — per-stage aux-loss accounting masks fill/drain ticks and
+psums stage contributions.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional
 
@@ -39,6 +42,26 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..models import tinygpt
 
 AXIS = "pipe"
+
+
+def _seq_setup(config: tinygpt.TinyGPTConfig, mesh: Mesh):
+    """Sequence-parallel composition: when the mesh carries a >1 'seq' axis,
+    the pipeline shard_map goes manual over BOTH ('pipe', 'seq') — activations
+    hold local sequence chunks, attention runs the sharded ring/Ulysses bodies
+    communicating over 'seq' (see tinygpt.TinyGPTConfig.seq_manual_axis), and
+    losses/aux psum over 'seq'. Returns (config, seq_axis_or_None, sp,
+    manual_axes, batch_in_spec)."""
+    sp = mesh.shape.get("seq", 1)
+    if sp <= 1:
+        return config, None, 1, frozenset({AXIS}), P()
+    config = dataclasses.replace(config, seq_manual_axis="seq")
+    return (
+        config,
+        "seq",
+        sp,
+        frozenset({AXIS, "seq"}),
+        P(None, None, "seq"),
+    )
 
 
 def pipeline_param_specs(params, mesh: Mesh):
@@ -67,11 +90,7 @@ def pipeline_loss_fn(
         raise ValueError(
             f"n_layer={config.n_layer} not divisible by pipe={n_stages}"
         )
-    if config.n_experts > 0:
-        raise ValueError(
-            "MoE does not compose with pipeline parallelism in this version "
-            "(per-stage aux-loss accounting); use dp/tp/ep"
-        )
+    config, seq_ax, sp, manual_axes, batch_spec = _seq_setup(config, mesh)
     layers_per_stage = config.n_layer // n_stages
     n_micro = batch.shape[0]
     ticks = n_micro + n_stages - 1
@@ -84,6 +103,10 @@ def pipeline_loss_fn(
         D = config.n_embd
         state = jnp.zeros((mb, S, D), config.compute_dtype)
         loss_sum = jnp.zeros((), jnp.float32)
+        # MoE load-balance aux: each stage accumulates its own layers' aux for
+        # the microbatches it actually processes (fill/drain ticks run on
+        # dummy state for schedule uniformity — their aux is masked out).
+        aux_sum = jnp.zeros((), jnp.float32)
 
         emb_key = (
             jax.random.fold_in(base_key, 1_000_003) if base_key is not None else None
@@ -108,9 +131,18 @@ def pipeline_loss_fn(
                 if base_key is not None and not deterministic
                 else None
             )
-            state_out, _ = tinygpt.apply_blocks(
+            state_out, aux_t = tinygpt.apply_blocks(
                 config, blocks, state_in, bk, deterministic, layer_offset=offset
             )
+            if config.n_experts > 0:
+                if seq_ax is not None:
+                    # Per-shard load-balance stats averaged across sequence
+                    # shards (the standard local-aux formulation); also makes
+                    # aux seq-invariant for the loss.
+                    aux_t = lax.psum(aux_t, seq_ax) / sp
+                fi = t - stage  # the microbatch this stage processed this tick
+                aux_valid = (fi >= 0) & (fi < n_micro)
+                aux_sum = aux_sum + jnp.where(aux_valid, aux_t, 0.0)
 
             # The last stage drains: at tick t it finishes microbatch
             # t - (P-1). The LM head is a (mb,S,D)x(V,D) einsum — layer-scale
@@ -123,13 +155,13 @@ def pipeline_loss_fn(
             if 0 <= li < n_micro:
                 if jax.default_backend() == "cpu":
                     logits = tinygpt.head(config, params, state_out)
-                    l = tinygpt._cross_entropy(logits, batch[li])
+                    l = tinygpt._cross_entropy(logits, batch[li], seq_axis=seq_ax)
                     loss_sum = loss_sum + jnp.where(stage == n_stages - 1, l, 0.0)
                 else:
                     loss_sum = loss_sum + lax.cond(
                         stage == n_stages - 1,
                         lambda so=state_out, tgt=batch[li]: tinygpt._cross_entropy(
-                            tinygpt.head(config, params, so), tgt
+                            tinygpt.head(config, params, so), tgt, seq_axis=seq_ax
                         ),
                         # pcast marks the zero as device-varying over 'pipe'
                         # so both branches carry the same manual-axes type.
@@ -142,14 +174,23 @@ def pipeline_loss_fn(
                 state = lax.ppermute(state_out, AXIS, perm)
 
         # Only the last stage accumulated loss; broadcast it to every stage.
-        return lax.psum(loss_sum, AXIS) / n_micro
+        loss = lax.psum(loss_sum, AXIS) / n_micro
+        if config.n_experts > 0:
+            # Every (stage, microbatch) pair contributed its layers' aux once:
+            # psum over stages = sum over all n_layer layers for all M
+            # microbatches. Same normalization as tinygpt.forward
+            # (coef * aux / n_layer), averaged over microbatches.
+            loss = loss + config.router_aux_coef * lax.psum(aux_sum, AXIS) / (
+                config.n_layer * n_micro
+            )
+        return loss
 
     fn = jax.shard_map(
         staged,
         mesh=mesh,
-        in_specs=(pipeline_param_specs(params, mesh), P()),
+        in_specs=(pipeline_param_specs(params, mesh), batch_spec),
         out_specs=P(),
-        axis_names=frozenset({AXIS}),
+        axis_names=manual_axes,
     )
     return fn(params, batch)
 
@@ -198,11 +239,7 @@ def pipeline_loss_and_grads_1f1b(
         raise ValueError(
             f"n_layer={config.n_layer} not divisible by pipe={n_stages}"
         )
-    if config.n_experts > 0:
-        raise ValueError(
-            "MoE does not compose with pipeline parallelism in this version "
-            "(per-stage aux-loss accounting); use dp/tp/ep"
-        )
+    config, seq_ax, sp, manual_axes, batch_spec = _seq_setup(config, mesh)
     layers_per_stage = config.n_layer // n_stages
     n_micro = batch.shape[0]
     ticks = n_micro + 2 * (n_stages - 1)
@@ -250,10 +287,25 @@ def pipeline_loss_and_grads_1f1b(
         offset = stage * layers_per_stage
         live_keys = base_key is not None and not deterministic
 
+        # MoE: the load-balance aux is a second differentiable output of the
+        # stage forward; its cotangent is the constant coef/(n_layer*n_micro)
+        # (the aux term's weight in the final loss) whenever the backward
+        # unit's microbatch is valid.
+        moe = config.n_experts > 0
+        aux_sum = jnp.zeros((), jnp.float32)
+        aux_ct_const = (
+            config.router_aux_coef / (config.n_layer * n_micro) if moe else 0.0
+        )
+
         def stage_fwd(blk, x, key):
-            return tinygpt.apply_blocks(
+            y, aux = tinygpt.apply_blocks(
                 config, blk, x, key, deterministic, layer_offset=offset
-            )[0]
+            )
+            if moe and seq_ax is not None:
+                # Shard-local aux averaged over sequence shards (seq-invariant
+                # so the loss and its constant cotangent stay uniform).
+                aux = lax.psum(aux, seq_ax) / sp
+            return (y, aux) if moe else y
 
         for t in range(ticks):
             # ---- forward unit: stage s runs microbatch t - s (as GPipe) ----
@@ -268,7 +320,15 @@ def pipeline_loss_and_grads_1f1b(
             buf = lax.dynamic_update_index_in_dim(buf, state_in, t % depth, 0)
             if t < n_micro + n_stages - 1:  # fwd window; later ticks drain only
                 bk = jax.random.fold_in(base_key, t) if live_keys else None
-                state_out = stage_fwd(blocks, state_in, bk)
+                out = stage_fwd(blocks, state_in, bk)
+                if moe:
+                    state_out, aux_t = out
+                    fi = t - stage
+                    aux_sum = aux_sum + jnp.where(
+                        (fi >= 0) & (fi < n_micro), aux_t, 0.0
+                    )
+                else:
+                    state_out = out
             else:
                 state_out = state_in
 
@@ -278,7 +338,7 @@ def pipeline_loss_and_grads_1f1b(
             if 0 <= li < n_micro:
                 def head_loss(hp_arg, x):
                     return tinygpt._cross_entropy(
-                        tinygpt.head(config, hp_arg, x), batch[li]
+                        tinygpt.head(config, hp_arg, x), batch[li], seq_axis=seq_ax
                     )
 
                 if head_cond:
@@ -292,10 +352,15 @@ def pipeline_loss_and_grads_1f1b(
 
                     def head_zero(so=state_out):
                         var = lambda z: lax.pcast(z, (AXIS,), to="varying")
+                        # The state cotangent is additionally seq-varying
+                        # (it is a local sequence chunk's gradient).
+                        var_x = lambda z: lax.pcast(
+                            z, (AXIS,) + ((seq_ax,) if seq_ax else ()), to="varying"
+                        )
                         return (
                             var(jnp.zeros((), jnp.float32)),
                             jax.tree.map(lambda x: var(jnp.zeros(x.shape, x.dtype)), hp),
-                            var(jnp.zeros_like(so)),
+                            var_x(jnp.zeros_like(so)),
                         )
 
                     l, d_hp_t, d_x_head = lax.cond(is_last, head_work, head_zero)
@@ -328,7 +393,11 @@ def pipeline_loss_and_grads_1f1b(
                 _, vjp_blk = jax.vjp(
                     lambda blk, x: stage_fwd(blk, x, bk_orig), blocks, x_saved
                 )
-                d_blk_t, d_x = vjp_blk(g_in)
+                if moe:
+                    aux_ct = jnp.where(vb, aux_ct_const, 0.0).astype(jnp.float32)
+                    d_blk_t, d_x = vjp_blk((g_in, aux_ct))
+                else:
+                    d_blk_t, d_x = vjp_blk(g_in)
                 d_blocks = jax.tree.map(jnp.add, d_blocks, d_blk_t)
 
                 # Stage 0's input cotangent belongs to the embedding. Its
@@ -361,6 +430,12 @@ def pipeline_loss_and_grads_1f1b(
                 state = lax.ppermute(state_out, AXIS, perm_fwd)
 
         loss = lax.psum(loss_sum, AXIS) * inv_m
+        if moe:
+            # Same accounting as the GPipe schedule: psum over stages covers
+            # all n_layer layers once per microbatch.
+            loss = loss + config.router_aux_coef * lax.psum(aux_sum, AXIS) / (
+                config.n_layer * n_micro
+            )
         if head_cond:
             # cond path kept d_hp varying (nonzero on the last stage only);
             # one psum re-replicates it.
@@ -383,8 +458,8 @@ def pipeline_loss_and_grads_1f1b(
     fn = jax.shard_map(
         staged,
         mesh=mesh,
-        in_specs=(specs, P()),
+        in_specs=(specs, batch_spec),
         out_specs=(P(), specs),
-        axis_names=frozenset({AXIS}),
+        axis_names=manual_axes,
     )
     return fn(params, batch)

@@ -117,15 +117,20 @@ def get_strategy(name: str) -> StrategyConfig:
 
 
 def _normalize_remat_field(value: Any) -> str:
-    """JSON remat field: bool (legacy, True="full") or policy string."""
-    if isinstance(value, bool):
-        return "full" if value else "none"
-    if value in ("none", "dots", "full", "auto"):
+    """JSON remat field: bool (legacy, True="full"), a model policy string,
+    or "auto" (resolved against the memory model before reaching the model —
+    the one value tinygpt.normalize_remat deliberately rejects)."""
+    if value == "auto":
         return value
-    raise ValueError(
-        f"invalid remat value {value!r} in strategy config "
-        "(expected bool or one of 'none'/'dots'/'full'/'auto')"
-    )
+    from ..models.tinygpt import normalize_remat
+
+    try:
+        return normalize_remat(value)
+    except ValueError:
+        raise ValueError(
+            f"invalid remat value {value!r} in strategy config "
+            "(expected bool or one of 'none'/'dots'/'full'/'auto')"
+        )
 
 
 def load_strategy_config(path: str) -> StrategyConfig:
@@ -408,6 +413,17 @@ def param_partition_specs(params: Params, mesh: Mesh, shard: bool) -> Params:
                 s[ax] = "expert"
         if n_model > 1:
             for ax in _TP_RULES.get(name, ()):
+                if name == "wte" and n_pipe > 1:
+                    # Pipeline runs keep the tied embedding replicated over
+                    # 'model': the schedule already replicates embed/head
+                    # across stages (every stage computes them for schedule
+                    # uniformity), and a vocab-sharded embedding gather inside
+                    # the partially-manual pipe region trips an XLA SPMD
+                    # partitioner CHECK (spmd_partitioner_util.cc:495) when
+                    # 'data' also shards the indices — the dp x tp x pp
+                    # triple. Megatron-LM likewise special-cases the
+                    # embedding's placement under pipeline parallelism.
+                    continue
                 if s[ax] is None and leaf.shape[ax] % n_model == 0:
                     s[ax] = "model"
         if shard and n_data > 1:

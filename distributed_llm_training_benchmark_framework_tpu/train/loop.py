@@ -119,31 +119,24 @@ def _run_benchmark_impl(
         raise ValueError(
             "sequence_parallel > 1 requires --attention ring or ulysses"
         )
-    if pp > 1 and attention_impl in ("ring", "ulysses"):
-        raise ValueError(
-            "pipeline_parallel does not compose with sequence-parallel "
-            "attention (ring/ulysses) yet; use dp/tp/pp"
-        )
     if pp > 1 and tp > 1 and jax.default_backend() == "cpu":
         # XLA's CPU-only AllReducePromotion pass aborts the process compiling
         # the partially-manual pipeline with tensor-parallel collectives
         # inside ("Invalid binary instruction opcode copy"). Workaround:
         # XLA_FLAGS=--xla_disable_hlo_passes=all-reduce-promotion compiles and
-        # runs tp x pp correctly on CPU (verified vs the ddp trajectory) —
-        # but the dp>1 x tp x pp triple still dies deeper in the SPMD
-        # partitioner (gather partitioning CHECK), so that stays guarded.
-        # TPU compiles all of these compositions.
+        # runs tp x pp — including dp>1 x tp x pp now that pipeline runs keep
+        # wte replicated over 'model' (the vocab-sharded embedding gather was
+        # what tripped the SPMD partitioner CHECK; see
+        # parallel/strategies.py param_partition_specs). TPU needs no flag.
         import os as _os
 
         from ..utils.platform import allreduce_promotion_disabled
 
-        workaround = allreduce_promotion_disabled(_os.environ.get("XLA_FLAGS", ""))
-        if not (workaround and dp == 1):
+        if not allreduce_promotion_disabled(_os.environ.get("XLA_FLAGS", "")):
             raise ValueError(
                 "pipeline_parallel x tensor_parallel on the CPU backend needs "
                 "XLA_FLAGS=--xla_disable_hlo_passes=all-reduce-promotion (XLA "
-                "CPU compiler bug), and dp must be 1 even then; run this "
-                "composition on TPU"
+                "CPU compiler bug); TPU runs this composition without flags"
             )
 
     overrides = {} if dropout is None else {"dropout": dropout}
@@ -167,8 +160,6 @@ def _run_benchmark_impl(
     model_config = get_model_config(
         tier, seq_len, attention_impl=attention_impl, **overrides
     )
-    if n_experts > 0 and pp > 1:
-        raise ValueError("MoE does not compose with pipeline parallelism yet")
     if is_main:
         print(f"Strategy: {strategy.describe()}")
         print(

@@ -121,15 +121,26 @@ def test_ep_trajectory_matches_single_device(eight_devices):
     np.testing.assert_allclose(ep, base, rtol=2e-3)
 
 
-def test_moe_rejects_pipeline():
+def test_moe_composes_with_pipeline(eight_devices):
+    """MoE x pp is a supported composition (round-2 verdict item 3): the
+    GPipe schedule's per-stage aux accounting reproduces the plain loss.
+    Grad parity (incl. 1F1B) is covered in tests/test_pipeline.py."""
+    from distributed_llm_training_benchmark_framework_tpu.models import loss_fn
     from distributed_llm_training_benchmark_framework_tpu.parallel.pipeline import (
         pipeline_loss_fn,
     )
 
-    cfg = moe_cfg()
+    # fp32 compute: XLA CPU's AllReducePromotion pass aborts on bf16
+    # collectives inside the pipeline (same note as tests/test_pipeline.py).
+    cfg = moe_cfg(compute_dtype=jnp.float32)
     params = init_params(cfg, jax.random.key(0))
     mesh = make_mesh(
         (1, 1, 1, 2), ("data", "seq", "model", "pipe"), devices=jax.devices()[:2]
     )
-    with pytest.raises(ValueError, match="MoE"):
-        pipeline_loss_fn(cfg, mesh, params, np.zeros((2, 1, 64), np.int32))
+    ds = SyntheticDataset(vocab_size=cfg.vocab_size, seq_len=64, size=8)
+    batch = ds.batch_for_step(0, 2 * 2).reshape(2, 2, 64)
+    with jax.set_mesh(mesh):
+        pl = pipeline_loss_fn(cfg, mesh, params, batch)
+    plain = np.mean([float(loss_fn(cfg, params, batch[i], batch[i]))
+                     for i in range(2)])
+    np.testing.assert_allclose(float(pl), plain, rtol=2e-3)

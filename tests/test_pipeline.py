@@ -115,6 +115,95 @@ def test_1f1b_with_dropout_matches_gpipe(eight_devices):
     )
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_pp_sp_loss_and_grads_match(eight_devices, impl):
+    """Sequence parallelism inside pipeline stages: with a >1 'seq' axis the
+    schedules go manual over ('pipe','seq') and attention runs the sharded
+    ring/Ulysses bodies. Loss matches the plain (reference-attention) forward
+    and the 1F1B hand-scheduled backward matches autodiff-GPipe gradients."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel.pipeline import (
+        pipeline_loss_and_grads_1f1b,
+    )
+
+    cfg = get_model_config(
+        "S", 64, dropout=0.0, attention_impl=impl, compute_dtype=jnp.float32
+    )
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh((1, 2, 1, 2), ("data", "seq", "model", "pipe"),
+                     devices=jax.devices()[:4])
+    ds = SyntheticDataset(vocab_size=512, seq_len=64, size=16)
+    batch = ds.batch_for_step(0, 4 * 2).reshape(4, 2, 64)
+
+    with jax.set_mesh(mesh):
+        pl_loss = pipeline_loss_fn(cfg, mesh, params, batch)
+    plain_cfg = dataclasses.replace(cfg, attention_impl="reference")
+    plain = np.mean([float(loss_fn(plain_cfg, params, batch[i], batch[i]))
+                     for i in range(4)])
+    np.testing.assert_allclose(float(pl_loss), plain, rtol=2e-3)
+
+    with jax.set_mesh(mesh):
+        g_loss, g_grads = jax.jit(
+            jax.value_and_grad(lambda p: pipeline_loss_fn(cfg, mesh, p, batch))
+        )(params)
+        f_loss, f_grads = jax.jit(
+            lambda p: pipeline_loss_and_grads_1f1b(cfg, mesh, p, batch)
+        )(params)
+    np.testing.assert_allclose(float(f_loss), float(g_loss), rtol=1e-5)
+    flat_f = dict(jax.tree_util.tree_leaves_with_path(f_grads))
+    for path, g in jax.tree_util.tree_leaves_with_path(g_grads):
+        np.testing.assert_allclose(
+            np.asarray(flat_f[path]), np.asarray(g), rtol=1e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+@pytest.mark.slow
+def test_moe_pp_loss_and_grads_match(eight_devices):
+    """MoE composes with the pipeline: per-stage aux accounting reproduces the
+    plain forward's loss (incl. the Switch aux term), and the 1F1B backward
+    carries the aux cotangent through the router gradients."""
+    import jax.numpy as jnp
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel.pipeline import (
+        pipeline_loss_and_grads_1f1b,
+    )
+
+    cfg = get_model_config(
+        "S", 64, dropout=0.0, n_experts=4, compute_dtype=jnp.float32
+    )
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh((1, 1, 1, 2), ("data", "seq", "model", "pipe"),
+                     devices=jax.devices()[:2])
+    ds = SyntheticDataset(vocab_size=512, seq_len=64, size=16)
+    batch = ds.batch_for_step(0, 4 * 2).reshape(4, 2, 64)
+
+    with jax.set_mesh(mesh):
+        pl_loss = pipeline_loss_fn(cfg, mesh, params, batch)
+    plain = np.mean([float(loss_fn(cfg, params, batch[i], batch[i]))
+                     for i in range(4)])
+    np.testing.assert_allclose(float(pl_loss), plain, rtol=2e-3)
+
+    with jax.set_mesh(mesh):
+        g_loss, g_grads = jax.jit(
+            jax.value_and_grad(lambda p: pipeline_loss_fn(cfg, mesh, p, batch))
+        )(params)
+        f_loss, f_grads = jax.jit(
+            lambda p: pipeline_loss_and_grads_1f1b(cfg, mesh, p, batch)
+        )(params)
+    np.testing.assert_allclose(float(f_loss), float(g_loss), rtol=1e-5)
+    flat_f = dict(jax.tree_util.tree_leaves_with_path(f_grads))
+    for path, g in jax.tree_util.tree_leaves_with_path(g_grads):
+        np.testing.assert_allclose(
+            np.asarray(flat_f[path]), np.asarray(g), rtol=1e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 def make_state(strategy, mesh_shape, grad_accum, **kw):
     cfg = get_model_config("S", 64, dropout=0.0)
     n = int(np.prod(mesh_shape))
@@ -159,8 +248,8 @@ def test_1f1b_trajectory_matches_gpipe(eight_devices):
 
 @pytest.mark.slow
 def test_pp_composes_with_tp_subprocess():
-    """tp=2 x pp=2 trajectory parity vs plain ddp, in a subprocess with
-    XLA_FLAGS=--xla_disable_hlo_passes=all-reduce-promotion.
+    """tp=2 x pp=2 AND dp=2 x tp=2 x pp=2 trajectory parity vs plain ddp, in
+    a subprocess with XLA_FLAGS=--xla_disable_hlo_passes=all-reduce-promotion.
 
     XLA's CPU-only AllReducePromotion pass aborts the whole process compiling
     pipeline(manual) x tensor-parallel(auto) collectives — round-1's verdict
@@ -168,8 +257,9 @@ def test_pp_composes_with_tp_subprocess():
     on any backend. Disabling that one pass (CPU-only, subprocess-scoped so
     the rest of the suite keeps stock flags) lets it compile and run; this
     asserts it computes the same trajectory as unpartitioned ddp. The dp>1
-    triple remains XLA-infeasible on CPU (SPMD-partitioner CHECK) and remains
-    guarded in loop.run_benchmark.
+    triple used to die separately in the SPMD partitioner (gather-partitioning
+    CHECK on the vocab-sharded embedding); pipeline runs now keep wte
+    replicated over 'model' (parallel/strategies.py), so it runs too.
     """
     import os
     import subprocess
@@ -203,6 +293,8 @@ def test_pp_composes_with_tp_subprocess():
         base = run((1, 1, 1, 1), 1)
         mixed = run((1, 1, 2, 2), 4)
         np.testing.assert_allclose(mixed, base, rtol=2e-3)
+        triple = run((2, 1, 2, 2), 8)
+        np.testing.assert_allclose(triple, base, rtol=2e-3)
         print("PP_TP_PARITY_OK", base)
     """)
     env = dict(os.environ)
