@@ -46,10 +46,12 @@ STEP_CV_LIMIT_PCT = 10.0
 # utils/memory.py's documented accuracy claim for the analytic model,
 # validated here against the measured column whenever one exists.
 EST_VS_MEASURED_TOL = 0.35
-# ...but only at benchmark scale: below this floor (tier-S CPU smoke runs,
-# tens of MB) the analytic model's ignored constants (runtime buffers,
-# padding) dominate and a relative band is meaningless.
-EST_VS_MEASURED_MIN_GB = 1.0
+# ...with an absolute-slack floor: at tiny footprints (tier-S smoke runs,
+# heavily-sharded per-device peaks) the analytic model's ignored constants
+# (runtime buffers, padding) dominate, so a pure relative band would flag
+# noise. A violation requires BOTH the relative band and this many GB of
+# absolute divergence. Tier-S smoke artifacts skip the check entirely.
+EST_VS_MEASURED_ABS_SLACK_GB = 0.25
 
 
 def _check(ok: bool, label: str, detail: str, failures: List[str]) -> None:
@@ -97,12 +99,14 @@ def validate_result(r: dict, name: str) -> List[str]:
     method = r.get("peak_hbm_method", "unavailable")
     if (
         est > 0
-        and measured >= EST_VS_MEASURED_MIN_GB
+        and measured > 0
+        and r.get("tier") != "S"
         and method in ("allocator", "xla_buffer_assignment")
     ):
         rel = abs(measured - est) / measured
         _check(
-            rel <= EST_VS_MEASURED_TOL, name,
+            rel <= EST_VS_MEASURED_TOL
+            or abs(measured - est) <= EST_VS_MEASURED_ABS_SLACK_GB, name,
             f"analytic est {est:.2f} GB vs measured {measured:.2f} GB "
             f"({method}) differ by {100*rel:.0f}% > "
             f"{100*EST_VS_MEASURED_TOL:.0f}% tolerance", f,

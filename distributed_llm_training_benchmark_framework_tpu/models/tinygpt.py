@@ -480,9 +480,9 @@ def apply_blocks(
     # the manual axes; the scalar aux carry must match that type or the scan
     # rejects the carry (invariant in, varying out after the first MoE add).
     def _aux0():
-        z = jnp.zeros((), jnp.float32)
-        vma = getattr(jax.typeof(x), "vma", ())
-        return lax.pcast(z, tuple(vma), to="varying") if vma else z
+        from ..utils.vma import pcast_like
+
+        return pcast_like(jnp.zeros((), jnp.float32), x)
 
     if not c.scan_layers:
         n_local = jax.tree_util.tree_leaves(blocks)[0].shape[0]

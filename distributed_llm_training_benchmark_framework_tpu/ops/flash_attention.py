@@ -202,11 +202,11 @@ def _vma_struct(shape, dtype, *like):
     under the sequence-manual pipeline), Pallas requires out_shapes to declare
     how outputs vary across the manual mesh axes — they vary exactly as the
     operands do (the kernel is pointwise in the shard dimension)."""
-    vma = set()
-    for a in like:
-        vma |= set(getattr(jax.typeof(a), "vma", ()) or ())
+    from ..utils.vma import vma_of
+
+    vma = vma_of(*like)
     if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
@@ -263,9 +263,9 @@ def _flash_forward(
     grid = (BH, S // bq, S // bk)
     if seed is None:
         seed = jnp.zeros((1,), jnp.uint32)
-    if interpret and any(
-        getattr(jax.typeof(a), "vma", None) for a in (q, k, v)
-    ):
+    from ..utils.vma import vma_of
+
+    if interpret and vma_of(q, k, v):
         return _jnp_reference_forward(q, k, v, causal, dropout_rate, seed)
     out, lse = pl.pallas_call(
         functools.partial(
@@ -504,14 +504,11 @@ def _jnp_blockwise_bwd(causal, bk, rate, res, do):
         dk_b = jnp.einsum("bqk,bqd->bkd", ds, q, preferred_element_type=f32)
         return dq_acc, (dk_b, dv_b)
 
-    dq0 = jnp.zeros((BH, S, D), f32)
     # Under a vma-checked manual region the accumulator carry must match the
     # varying type the block updates produce.
-    vma = set()
-    for a in (q, k, v, do):
-        vma |= set(getattr(jax.typeof(a), "vma", ()) or ())
-    if vma:
-        dq0 = lax.pcast(dq0, tuple(vma), to="varying")
+    from ..utils.vma import pcast_like
+
+    dq0 = pcast_like(jnp.zeros((BH, S, D), f32), q, k, v, do)
     dq, (dk_blocks, dv_blocks) = lax.scan(one_block, dq0, (jnp.arange(nk), ks, vs))
     dk = dk_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
     dv = dv_blocks.transpose(1, 0, 2, 3).reshape(BH, S, D)
@@ -526,9 +523,9 @@ def _flash_bwd_rule(opts, res, do):
     """
     causal, interpret, bq, bk_fwd, bk, pallas_bwd, rate = opts
     seed_ct = np.zeros((1,), jax.dtypes.float0)  # seed is integral: no tangent
-    if pallas_bwd and interpret and any(
-        getattr(jax.typeof(a), "vma", None) for a in res[:3] + (do,)
-    ):
+    from ..utils.vma import vma_of
+
+    if pallas_bwd and interpret and vma_of(*res[:3], do):
         # Same limitation the forward's _jnp_reference_forward fallback works
         # around: the Pallas HLO interpreter cannot run on vma-carrying
         # operands (seq-manual pipeline on CPU) — take the jnp backward.

@@ -11,21 +11,38 @@ continues the step count and LR schedule exactly.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 
 
 class BenchmarkCheckpointer:
-    """Thin wrapper over orbax CheckpointManager for (params, opt_state, step)."""
+    """Thin wrapper over orbax CheckpointManager for (params, opt_state, step).
 
-    def __init__(self, directory: str, max_to_keep: int = 3, save_every: int = 0):
+    ``layout`` records how the parameter pytree is physically laid out —
+    currently the pipeline schedule and virtual-stage count, because the
+    interleaved schedule permutes the stacked layer axis
+    (parallel.interleaved.layer_permutation). Shapes are identical across
+    layouts, so without this tag a resume under a different schedule would
+    silently load every layer's weights at the wrong depth; restore() fails
+    loudly on a mismatch instead.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_every: int = 0,
+        layout: Optional[Dict[str, Any]] = None,
+    ):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         self.save_every = save_every
+        self.layout = dict(layout or {})
         os.makedirs(self.directory, exist_ok=True)
         self.manager = ocp.CheckpointManager(
             self.directory,
@@ -33,6 +50,10 @@ class BenchmarkCheckpointer:
                 max_to_keep=max_to_keep, create=True
             ),
         )
+
+    @property
+    def _layout_path(self) -> str:
+        return os.path.join(self.directory, "layout.json")
 
     def should_save(self, step: int) -> bool:
         return self.save_every > 0 and step > 0 and step % self.save_every == 0
@@ -48,6 +69,9 @@ class BenchmarkCheckpointer:
         )
         if saved:
             self.manager.wait_until_finished()
+            if not os.path.exists(self._layout_path):
+                with open(self._layout_path, "w") as f:
+                    json.dump(self.layout, f)
         return bool(saved)
 
     def latest_step(self) -> Optional[int]:
@@ -60,6 +84,18 @@ class BenchmarkCheckpointer:
         step = self.manager.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        if os.path.exists(self._layout_path):
+            with open(self._layout_path) as f:
+                saved_layout = json.load(f)
+            if saved_layout != self.layout:
+                raise ValueError(
+                    f"checkpoint at {self.directory} was saved with parameter "
+                    f"layout {saved_layout}, but this run uses {self.layout} "
+                    "— the interleaved schedule permutes the stacked layer "
+                    "axis, so resuming across layouts would silently load "
+                    "layers at the wrong depth. Re-run with the original "
+                    "--pipeline-schedule/--virtual-stages or start fresh."
+                )
 
         def as_abstract(tree):
             return jax.tree.map(

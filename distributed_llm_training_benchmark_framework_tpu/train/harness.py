@@ -62,12 +62,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--skip-memory-check", action="store_true",
                    help="Attempt the run even when the pre-flight HBM "
                         "estimate says it will not fit on this device")
-    p.add_argument("--pipeline-schedule", choices=["gpipe", "1f1b"],
+    p.add_argument("--pipeline-schedule",
+                   choices=["gpipe", "1f1b", "interleaved"],
                    default="gpipe",
                    help="Pipeline schedule: 'gpipe' (autodiff fill-drain, "
-                        "O(M) activation liveness) or '1f1b' (hand-scheduled "
-                        "interleaved backward, O(P) liveness for long "
-                        "accumulation chains)")
+                        "O(M) activation liveness), '1f1b' (hand-scheduled "
+                        "backward, O(P) liveness for long accumulation "
+                        "chains), or 'interleaved' (Megatron virtual stages "
+                        "— shrinks the fill/drain bubble by ~the "
+                        "--virtual-stages factor)")
+    p.add_argument("--virtual-stages", type=int, default=2,
+                   help="Layer chunks per pipeline stage for "
+                        "--pipeline-schedule interleaved (n_layer must "
+                        "divide pipe * virtual)")
     p.add_argument("--expert-parallel", type=int, default=1,
                    help="Expert-parallel ('expert' mesh axis) width; needs "
                         "--num-experts divisible by it")
@@ -213,6 +220,7 @@ def main(argv=None) -> int:
             sequence_parallel=args.sequence_parallel,
             pipeline_parallel=args.pipeline_parallel,
             pipeline_schedule=args.pipeline_schedule,
+            virtual_stages=args.virtual_stages,
             skip_memory_check=args.skip_memory_check,
             expert_parallel=args.expert_parallel,
             n_experts=args.num_experts,

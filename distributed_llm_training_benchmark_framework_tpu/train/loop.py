@@ -70,6 +70,7 @@ def _run_benchmark_impl(
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
     pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 2,
     expert_parallel: int = 1,
     n_experts: int = 0,
     results_dir: Optional[str] = None,
@@ -205,7 +206,7 @@ def _run_benchmark_impl(
     state = create_train_state(
         model_config, strategy, mesh, seed=seed, grad_accum=grad_accum,
         from_table=True, global_micro=global_micro, seq_len=seq_len,
-        pipeline_schedule=pipeline_schedule,
+        pipeline_schedule=pipeline_schedule, virtual_stages=virtual_stages,
     )
     if is_main:
         print(f"Model initialized: {state.n_params/1e6:.2f}M parameters")
@@ -238,7 +239,18 @@ def _run_benchmark_impl(
     if checkpoint_dir:
         from ..runtime.checkpoint import BenchmarkCheckpointer
 
-        ckpt = BenchmarkCheckpointer(checkpoint_dir, save_every=checkpoint_every)
+        ckpt = BenchmarkCheckpointer(
+            checkpoint_dir, save_every=checkpoint_every,
+            # The interleaved schedule permutes the stacked layer axis; tag
+            # the checkpoint so a mismatched resume fails loudly.
+            layout={
+                "pipeline_schedule": pipeline_schedule if pp > 1 else "none",
+                "virtual_stages": (
+                    virtual_stages
+                    if pp > 1 and pipeline_schedule == "interleaved" else 1
+                ),
+            },
+        )
         if resume and ckpt.latest_step() is not None:
             params, opt_state, start_step = ckpt.restore(params, opt_state)
             start_step += 1
@@ -345,6 +357,10 @@ def _run_benchmark_impl(
         sequence_parallel=sp,
         pipeline_parallel=pp,
         pipeline_schedule=pipeline_schedule,
+        virtual_stages=(
+            virtual_stages if pp > 1 and pipeline_schedule == "interleaved"
+            else 1
+        ),
         expert_parallel=ep,
         n_experts=n_experts,
         remat_policy=state.model_config.remat,

@@ -97,6 +97,7 @@ def make_train_step(
     global_micro: int = 1,
     seq_len: int = 0,
     pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 2,
 ) -> Callable:
     """Build the jitted train step for one strategy arm.
 
@@ -133,15 +134,16 @@ def make_train_step(
 
     pipelined = mesh.shape.get("pipe", 1) > 1
     if pipelined:
+        from ..parallel.interleaved import interleaved_loss_and_grads
         from ..parallel.pipeline import (
             pipeline_loss_and_grads_1f1b,
             pipeline_loss_fn,
         )
 
-        if pipeline_schedule not in ("gpipe", "1f1b"):
+        if pipeline_schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
                 f"unknown pipeline schedule {pipeline_schedule!r} "
-                "(expected 'gpipe' or '1f1b')"
+                "(expected 'gpipe', '1f1b' or 'interleaved')"
             )
 
     def train_step(params, opt_state, batch, step):
@@ -165,7 +167,16 @@ def make_train_step(
             grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
             return (loss_acc + loss, grad_acc), None
 
-        if pipelined and pipeline_schedule == "1f1b":
+        if pipelined and pipeline_schedule == "interleaved":
+            # Virtual stages (Megatron interleaved 1F1B): the bubble-shrinking
+            # schedule — see parallel.interleaved. Requires params stacked in
+            # layer_permutation order (create_train_state handles it).
+            loss, grads = interleaved_loss_and_grads(
+                cfg, mesh, params, batch, virtual=virtual_stages,
+                base_key=None if deterministic_dropout else base_key,
+                deterministic=deterministic_dropout,
+            )
+        elif pipelined and pipeline_schedule == "1f1b":
             # Hand-scheduled backward (O(P) residual liveness) — see
             # parallel.pipeline.pipeline_loss_and_grads_1f1b.
             loss, grads = pipeline_loss_and_grads_1f1b(
@@ -258,6 +269,7 @@ def create_train_state(
     global_micro: int = 1,
     seq_len: int = 0,
     pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 2,
 ) -> TrainState:
     """Initialize params + optimizer state directly into their target shardings.
 
@@ -268,9 +280,27 @@ def create_train_state(
     cfg = _resolve_model_config(model_config, strategy, mesh)
     optimizer = strat.make_optimizer(strategy)
 
-    params_shape = jax.eval_shape(
-        functools.partial(tinygpt.init_params, cfg), jax.random.key(0)
-    )
+    def init_fn(key):
+        p = tinygpt.init_params(cfg, key)
+        if (
+            pipeline_schedule == "interleaved"
+            and mesh.shape.get("pipe", 1) > 1
+        ):
+            # Interleaved virtual stages: device d owns chunks {v*P + d}, so
+            # the stacked layer weights are permuted before the contiguous
+            # 'pipe' sharding lands (parallel.interleaved.layer_permutation).
+            # Params/grads/Adam state live in this layout for the whole run;
+            # dropout keys use global layer indices, so the math is
+            # layout-independent.
+            from ..parallel.interleaved import layer_permutation
+
+            perm = layer_permutation(
+                cfg.n_layer, mesh.shape["pipe"], virtual_stages
+            )
+            p["blocks"] = jax.tree.map(lambda x: x[perm], p["blocks"])
+        return p
+
+    params_shape = jax.eval_shape(init_fn, jax.random.key(0))
     param_specs = strat.param_partition_specs(
         params_shape, mesh, shard=strategy.shard_params
     )
@@ -280,7 +310,7 @@ def create_train_state(
 
     with mesh:
         params = jax.jit(
-            functools.partial(tinygpt.init_params, cfg),
+            init_fn,
             out_shardings=strat.named(mesh, param_specs),
         )(jax.random.key(seed))
         opt_state = jax.jit(
@@ -301,6 +331,7 @@ def create_train_state(
         global_micro=global_micro,
         seq_len=seq_len,
         pipeline_schedule=pipeline_schedule,
+        virtual_stages=virtual_stages,
     )
     return TrainState(
         params=params,

@@ -207,6 +207,12 @@ def format_breakdown(est: HBMEstimate, device_kind: str) -> str:
     return "\n".join(lines)
 
 
+# Headroom for remat-policy selection (resolve_auto_remat): the analytic
+# estimate must stay below this fraction of HBM before a cheaper policy is
+# chosen. Derived from the measured est->actual bias (docs/PERFORMANCE.md).
+AUTO_REMAT_MARGIN = 0.70
+
+
 def check_fits(
     est: HBMEstimate, device_kind: str, margin: float = 0.95
 ) -> Optional[str]:
@@ -250,6 +256,16 @@ def resolve_auto_remat(
     .md), so the tax is only paid under actual memory pressure. Returns the
     strategy unchanged unless remat == "auto". Unknown device kinds (CPU)
     are never refused by check_fits, so they resolve to "none".
+
+    The policy choice uses a STRICTER margin than the go/no-go pre-flight
+    (AUTO_REMAT_MARGIN vs check_fits' 0.95): measured peaks run 13-50% above
+    the analytic estimate (XLA temp buffers the model ignores — see the
+    est-vs-measured table in docs/PERFORMANCE.md), and a policy that
+    nominally fits at 92% of HBM thrashes the allocator in practice
+    (zero3 @ 16K seq: est 14.7/16 GiB under "none" ran with 10 s -> 87 s
+    oscillating step times until the suite timeout). Picking the next
+    policy up costs only its recompute tax; picking one level too low
+    costs the whole run.
     """
     import dataclasses as _dc
 
@@ -261,7 +277,7 @@ def resolve_auto_remat(
         est = estimate_hbm(
             cfg, cand, mesh, per_device_batch, seq_len, dataset_size=dataset_size
         )
-        if check_fits(est, device_kind) is None:
+        if check_fits(est, device_kind, margin=AUTO_REMAT_MARGIN) is None:
             return cand
     # Nothing fits; return the most memory-frugal policy and let the
     # pre-flight check downstream produce the refusal message.

@@ -163,6 +163,7 @@ class BenchmarkResult:
     sequence_parallel: int = 1
     pipeline_parallel: int = 1
     pipeline_schedule: str = "gpipe"  # meaningful when pipeline_parallel > 1
+    virtual_stages: int = 1  # interleaved schedule: layer chunks per stage
     expert_parallel: int = 1
     n_experts: int = 0
     # The remat policy the run actually executed with ("none"/"dots"/"full")
@@ -204,6 +205,7 @@ def compute_result(
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
     pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 1,
     expert_parallel: int = 1,
     n_experts: int = 0,
     remat_policy: str = "none",
@@ -276,6 +278,7 @@ def compute_result(
         sequence_parallel=sequence_parallel,
         pipeline_parallel=pipeline_parallel,
         pipeline_schedule=pipeline_schedule,
+        virtual_stages=virtual_stages,
         expert_parallel=expert_parallel,
         n_experts=n_experts,
         remat_policy=remat_policy,
