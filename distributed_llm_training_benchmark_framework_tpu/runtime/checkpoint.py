@@ -21,13 +21,15 @@ import jax
 class BenchmarkCheckpointer:
     """Thin wrapper over orbax CheckpointManager for (params, opt_state, step).
 
-    ``layout`` records how the parameter pytree is physically laid out —
-    currently the pipeline schedule and virtual-stage count, because the
+    ``layout`` records how the parameter pytree is PHYSICALLY laid out — the
     interleaved schedule permutes the stacked layer axis
-    (parallel.interleaved.layer_permutation). Shapes are identical across
-    layouts, so without this tag a resume under a different schedule would
-    silently load every layer's weights at the wrong depth; restore() fails
-    loudly on a mismatch instead.
+    (parallel.interleaved.layer_permutation), while gpipe/1f1b/no-pipeline
+    all share the contiguous layout (and may resume each other freely).
+    Shapes are identical across layouts, so without this tag a resume across
+    a permuted/contiguous boundary would silently load every layer's weights
+    at the wrong depth; restore() fails loudly instead — including when the
+    tag file is missing but this run expects a permuted layout (a checkpoint
+    from a version predating the tag is always contiguous).
     """
 
     def __init__(
@@ -42,7 +44,7 @@ class BenchmarkCheckpointer:
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         self.save_every = save_every
-        self.layout = dict(layout or {})
+        self.layout = dict(layout or {"layer_layout": "contiguous"})
         os.makedirs(self.directory, exist_ok=True)
         self.manager = ocp.CheckpointManager(
             self.directory,
@@ -69,9 +71,10 @@ class BenchmarkCheckpointer:
         )
         if saved:
             self.manager.wait_until_finished()
-            if not os.path.exists(self._layout_path):
-                with open(self._layout_path, "w") as f:
-                    json.dump(self.layout, f)
+            # Always rewrite: a stale tag from a previous run in a reused
+            # directory would mis-label these checkpoints.
+            with open(self._layout_path, "w") as f:
+                json.dump(self.layout, f)
         return bool(saved)
 
     def latest_step(self) -> Optional[int]:
@@ -87,15 +90,19 @@ class BenchmarkCheckpointer:
         if os.path.exists(self._layout_path):
             with open(self._layout_path) as f:
                 saved_layout = json.load(f)
-            if saved_layout != self.layout:
-                raise ValueError(
-                    f"checkpoint at {self.directory} was saved with parameter "
-                    f"layout {saved_layout}, but this run uses {self.layout} "
-                    "— the interleaved schedule permutes the stacked layer "
-                    "axis, so resuming across layouts would silently load "
-                    "layers at the wrong depth. Re-run with the original "
-                    "--pipeline-schedule/--virtual-stages or start fresh."
-                )
+        else:
+            # Pre-tag checkpoints were always written in the contiguous
+            # layout (the tag shipped together with the interleaved schedule).
+            saved_layout = {"layer_layout": "contiguous"}
+        if saved_layout != self.layout:
+            raise ValueError(
+                f"checkpoint at {self.directory} was saved with parameter "
+                f"layout {saved_layout}, but this run uses {self.layout} "
+                "— the interleaved schedule permutes the stacked layer "
+                "axis, so resuming across layouts would silently load "
+                "layers at the wrong depth. Re-run with the original "
+                "--pipeline-schedule/--virtual-stages or start fresh."
+            )
 
         def as_abstract(tree):
             return jax.tree.map(

@@ -73,8 +73,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "--virtual-stages factor)")
     p.add_argument("--virtual-stages", type=int, default=2,
                    help="Layer chunks per pipeline stage for "
-                        "--pipeline-schedule interleaved (n_layer must "
-                        "divide pipe * virtual)")
+                        "--pipeline-schedule interleaved (pipe * virtual "
+                        "must divide n_layer)")
     p.add_argument("--expert-parallel", type=int, default=1,
                    help="Expert-parallel ('expert' mesh axis) width; needs "
                         "--num-experts divisible by it")

@@ -239,15 +239,16 @@ def _run_benchmark_impl(
     if checkpoint_dir:
         from ..runtime.checkpoint import BenchmarkCheckpointer
 
+        # Tag the PHYSICAL parameter layout: interleaved permutes the stacked
+        # layer axis (per virtual-stage count); gpipe/1f1b/no-pipeline share
+        # the contiguous layout and may resume each other freely.
+        interleaved = pp > 1 and pipeline_schedule == "interleaved"
         ckpt = BenchmarkCheckpointer(
             checkpoint_dir, save_every=checkpoint_every,
-            # The interleaved schedule permutes the stacked layer axis; tag
-            # the checkpoint so a mismatched resume fails loudly.
             layout={
-                "pipeline_schedule": pipeline_schedule if pp > 1 else "none",
-                "virtual_stages": (
-                    virtual_stages
-                    if pp > 1 and pipeline_schedule == "interleaved" else 1
+                "layer_layout": (
+                    f"interleaved:pp={pp}:v={virtual_stages}" if interleaved
+                    else "contiguous"
                 ),
             },
         )

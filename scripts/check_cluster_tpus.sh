@@ -1,11 +1,15 @@
 #!/usr/bin/env bash
 # Cluster preflight: verify TPU capacity before launching the suite.
 #
-# Parity with reference scripts/check_cluster_gpus.sh: kubectl connectivity,
-# device-plugin presence, per-node capacity table, total/in-use accounting,
-# namespace existence, recommended test matrix. GPU checks become TPU checks
-# (google.com/tpu resource, TPU node selectors/topology labels).
+# Parity with reference scripts/check_cluster_gpus.sh:41-116: kubectl
+# connectivity, per-node capacity/allocatable/in-use table, readiness and
+# taints, total vs in-use accounting, stuck-pending detection, namespace +
+# serviceaccount checks, and a recommended test matrix sized by FREE chips.
+# GPU checks become TPU checks (google.com/tpu resource, GKE TPU
+# accelerator/topology labels).
 set -uo pipefail
+
+FAIL=0
 
 echo "=== TPU Cluster Preflight ==="
 
@@ -15,38 +19,90 @@ if ! kubectl version >/dev/null 2>&1; then
 fi
 echo "OK"
 
-echo "--- TPU-capable nodes ---"
 NODES=$(kubectl get nodes -o json)
-echo "$NODES" | jq -r '
-  .items[]
-  | select(.status.capacity["google.com/tpu"] != null)
+PODS=$(kubectl get pods --all-namespaces -o json)
+
+echo ""
+echo "--- TPU-capable nodes (capacity / allocatable / requested-by-pods) ---"
+# Per-node in-use: sum of google.com/tpu requests of LIVE pods scheduled
+# there (Succeeded/Failed pods keep nodeName but hold no resources — the
+# scheduler ignores them, so must we). One jq pass builds a node->chips map;
+# a second renders the table. (The reference computes the same per-GPU-node
+# accounting; a node with allocatable chips but full requests is why jobs
+# sit Pending.)
+USED_BY_NODE=$(echo "$PODS" | jq '
+  [.items[]
+   | select(.spec.nodeName != null)
+   | select(.status.phase != "Succeeded" and .status.phase != "Failed")
+   | {node: .spec.nodeName,
+      tpu: ([.spec.containers[].resources.requests["google.com/tpu"] // "0"
+             | tonumber] | add)}]
+  | group_by(.node)
+  | map({key: .[0].node, value: ([.[].tpu] | add)}) | from_entries')
+echo "$NODES" | jq -r --argjson used "$USED_BY_NODE" '
+  .items[] | select(.status.capacity["google.com/tpu"] != null)
   | [.metadata.name,
      (.metadata.labels["cloud.google.com/gke-tpu-accelerator"] // "?"),
      (.metadata.labels["cloud.google.com/gke-tpu-topology"] // "?"),
+     ([.status.conditions[] | select(.type == "Ready") | .status] | first // "?"),
      .status.capacity["google.com/tpu"],
-     .status.allocatable["google.com/tpu"]]
-  | @tsv' | column -t -N "NODE,ACCELERATOR,TOPOLOGY,CAPACITY,ALLOCATABLE" \
-  || echo "(no TPU nodes found)"
+     .status.allocatable["google.com/tpu"],
+     ($used[.metadata.name] // 0 | tostring),
+     ([.spec.taints[]? | select(.effect == "NoSchedule") | .key]
+      | join(",") | if . == "" then "-" else . end)]
+  | @tsv' \
+  | column -t -N "NODE,ACCELERATOR,TOPOLOGY,READY,CAP,ALLOC,IN_USE,NOSCHED_TAINTS" \
+  || echo "  (no TPU nodes found)"
+N_TPU_NODES=$(echo "$NODES" | jq '[.items[]
+  | select(.status.capacity["google.com/tpu"] != null)] | length')
+[ "$N_TPU_NODES" -eq 0 ] && FAIL=1
 
-TOTAL=$(echo "$NODES" | jq '[.items[].status.allocatable["google.com/tpu"] // "0" | tonumber] | add')
-echo "Total allocatable TPU chips: ${TOTAL:-0}"
+TOTAL=$(echo "$NODES" | jq '[.items[]
+  | .status.allocatable["google.com/tpu"] // "0" | tonumber] | add')
+IN_USE=$(echo "$USED_BY_NODE" | jq '[.[]] | add // 0')
+FREE=$(( ${TOTAL:-0} - ${IN_USE:-0} ))
+echo ""
+echo "Total allocatable TPU chips: ${TOTAL:-0}; requested by scheduled pods: ${IN_USE:-0}; free: $FREE"
 
-echo "--- chips currently requested by pods ---"
-IN_USE=$(kubectl get pods --all-namespaces -o json | jq '
-  [.items[].spec.containers[].resources.requests["google.com/tpu"] // "0" | tonumber] | add')
-echo "In use: ${IN_USE:-0} / ${TOTAL:-0}"
+echo ""
+echo "--- pods stuck Pending on TPU requests ---"
+PENDING=$(echo "$PODS" | jq -r '
+  [.items[] | select(.status.phase == "Pending")
+   | select([.spec.containers[].resources.requests["google.com/tpu"] // "0"
+             | tonumber] | add > 0)
+   | "\(.metadata.namespace)/\(.metadata.name)"] | join(" ")')
+if [ -n "$PENDING" ]; then
+  echo "WARNING: pending TPU pods (cluster full or unschedulable): $PENDING"
+else
+  echo "none"
+fi
 
-echo "--- bench namespace ---"
+echo ""
+echo "--- bench namespace + serviceaccount ---"
 if kubectl get namespace bench >/dev/null 2>&1; then
   echo "OK: namespace 'bench' exists"
+  if kubectl -n bench get serviceaccount bench-runner >/dev/null 2>&1; then
+    echo "OK: serviceaccount 'bench-runner' exists"
+  else
+    echo "NOTE: serviceaccount 'bench-runner' missing — apply k8s/serviceaccount.yaml"
+  fi
 else
   echo "NOTE: namespace 'bench' missing — will be created by launch scripts"
 fi
 
-if [ "${TOTAL:-0}" -ge 4 ]; then
-  echo ""
-  echo "Recommended matrix (>=4 chips available):"
-  echo "  strategies: ddp fsdp zero2 zero3"
-  echo "  world sizes: 1 2 4$( [ "$TOTAL" -ge 8 ] && echo ' 8')"
-  echo "  scripts/run_all_benchmarks.sh --k8s"
+echo ""
+if [ "$FREE" -ge 1 ]; then
+  WS="1"
+  for ws in 2 4 8 16; do [ "$FREE" -ge "$ws" ] && WS="$WS $ws"; done
+  echo "Recommended matrix ($FREE chips free):"
+  echo "  strategies:  ddp fsdp zero2 zero3"
+  echo "  world sizes: $WS   (ws=1 included so scaling efficiency has a true baseline)"
+  echo "  launch:      scripts/run_all_benchmarks.sh --k8s"
+  [ "$FREE" -ge 4 ] && \
+    echo "  extras:      --tensor-parallel/--sequence-parallel/--pipeline-parallel compositions fit at ws>=4"
+else
+  echo "No free TPU chips — drain or wait before launching."
+  FAIL=1
 fi
+
+exit "$FAIL"

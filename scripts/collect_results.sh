@@ -34,14 +34,36 @@ case "${1:-}" in
   --k8s)
     [ $# -eq 4 ] || usage
     NS="$2"; JOB="$3"; OUT="$4"
-    POD=$(kubectl -n "$NS" get pods -l "job-name=$JOB" \
-          -o jsonpath='{.items[0].metadata.name}')
-    if [ -z "$POD" ]; then echo "ERROR: no pod for job $JOB" >&2; exit 1; fi
-    PHASE=$(kubectl -n "$NS" get pod "$POD" -o jsonpath='{.status.phase}')
-    echo "Pod $POD phase: $PHASE"
+    # Multi-host jobs run N symmetric pods (Indexed Job, one per host
+    # process). Save EVERY pod's log — rank>0 logs are the only diagnostics
+    # for rendezvous failures (the reference collects master and worker logs
+    # separately for the same reason) — and extract the result JSON from
+    # whichever pod printed the markers (rank 0 by contract).
+    PODS=$(kubectl -n "$NS" get pods -l "job-name=$JOB" \
+           -o jsonpath='{range .items[*]}{.metadata.name}{"\n"}{end}')
+    if [ -z "$PODS" ]; then echo "ERROR: no pod for job $JOB" >&2; exit 1; fi
     mkdir -p "$OUT"
-    kubectl -n "$NS" logs "$POD" > "$OUT/$JOB.log"
-    extract "$OUT/$JOB.log" "$OUT/${JOB}_results"
+    EXTRACTED=0
+    N=0
+    for POD in $PODS; do
+      # Guarded: a Pending/deleted pod must not abort the loop (set -e) —
+      # the other pods' logs are exactly what we came for.
+      PHASE=$(kubectl -n "$NS" get pod "$POD" \
+              -o jsonpath='{.status.phase}' 2>/dev/null || echo "unknown")
+      echo "Pod $POD phase: $PHASE"
+      LOG="$OUT/$POD.log"
+      kubectl -n "$NS" logs "$POD" > "$LOG" 2>/dev/null \
+        || echo "(no logs for $POD — container never started?)" > "$LOG"
+      if [ "$EXTRACTED" -eq 0 ] \
+         && grep -q "BENCHMARK_RESULT_JSON_START" "$LOG" 2>/dev/null; then
+        extract "$LOG" "$OUT/${JOB}_results" && EXTRACTED=1
+      fi
+      N=$((N + 1))
+    done
+    if [ "$EXTRACTED" -eq 0 ]; then
+      echo "ERROR: no result JSON in any of $N pod log(s) for $JOB" >&2
+      exit 1
+    fi
     ;;
   *) usage ;;
 esac

@@ -85,3 +85,48 @@ def test_should_save_cadence(tmp_path):
     none = BenchmarkCheckpointer(str(tmp_path / "n"), save_every=0)
     assert not none.should_save(100)
     none.close()
+
+
+def test_layout_mismatch_refused(tmp_path):
+    """Interleaved-permuted checkpoints refuse a contiguous-layout resume
+    (and vice versa) — shapes match, so without the tag every layer would
+    silently load at the wrong depth. Missing tag = contiguous (pre-tag
+    checkpoints were always contiguous)."""
+    state = make_state()
+    d = str(tmp_path / "il")
+    saver = BenchmarkCheckpointer(
+        d, layout={"layer_layout": "interleaved:pp=2:v=2"}
+    )
+    saver.save(1, state.params, state.opt_state)
+    saver.close()
+
+    wrong = BenchmarkCheckpointer(d)  # default: contiguous
+    with pytest.raises(ValueError, match="layout"):
+        wrong.restore(state.params, state.opt_state)
+    wrong.close()
+
+    right = BenchmarkCheckpointer(
+        d, layout={"layer_layout": "interleaved:pp=2:v=2"}
+    )
+    rp, _, step = right.restore(state.params, state.opt_state)
+    assert step == 1
+    right.close()
+
+    # Pre-tag checkpoint (no layout.json): contiguous resumes fine,
+    # interleaved is refused.
+    import os as _os
+
+    d2 = str(tmp_path / "legacy")
+    legacy = BenchmarkCheckpointer(d2)
+    legacy.save(1, state.params, state.opt_state)
+    legacy.close()
+    _os.remove(_os.path.join(d2, "layout.json"))
+    ok = BenchmarkCheckpointer(d2)
+    ok.restore(state.params, state.opt_state)
+    ok.close()
+    bad = BenchmarkCheckpointer(
+        d2, layout={"layer_layout": "interleaved:pp=2:v=2"}
+    )
+    with pytest.raises(ValueError, match="layout"):
+        bad.restore(state.params, state.opt_state)
+    bad.close()

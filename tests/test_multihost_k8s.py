@@ -1,0 +1,220 @@
+"""Multi-host (NUM_HOSTS=2) k8s contract tests — hermetic, fake kubectl.
+
+Round-2 verdict item 6: the suite tests only exercised the NUM_HOSTS=1 path;
+a real pod-slice run depends on the completion-index -> process-id contract,
+the coordinator DNS name baked into the rendered manifest, and collecting
+logs from N symmetric pods (only rank 0 prints the result markers). These
+tests pin all three against `launch_multi.sh`, `k8s/job-benchmark.template
+.yaml`, `scripts/collect_results.sh` and `docker/entrypoint.sh`.
+"""
+
+import json
+import os
+import stat
+import subprocess
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAKE_KUBECTL = r'''#!/usr/bin/env python3
+"""Stub kubectl for multi-pod jobs: records argv, serves 2 pods per job;
+only pod -0 prints the result markers (rank 0 by contract)."""
+import json, os, re, sys
+
+argv = sys.argv[1:]
+logdir = os.environ["FAKE_KUBECTL_DIR"]
+npods = int(os.environ.get("FAKE_NUM_PODS", "2"))
+with open(os.path.join(logdir, "calls.log"), "a") as f:
+    f.write(json.dumps(argv) + "\n")
+
+def arg_after(flag):
+    return argv[argv.index(flag) + 1] if flag in argv else None
+
+if "apply" in argv:
+    if "-" in argv:
+        manifest = sys.stdin.read()
+        m = re.search(r"name: (tpu-bench[\w-]*)", manifest)
+        name = m.group(1) if m else "unknown"
+        with open(os.path.join(logdir, f"manifest_{name}.yaml"), "w") as f:
+            f.write(manifest)
+    print("applied")
+    sys.exit(0)
+
+if "wait" in argv:
+    sys.exit(0)
+
+if "get" in argv and "pods" in argv:
+    sel = arg_after("-l") or ""
+    job = sel.split("=", 1)[1]
+    print("\n".join(f"{job}-{i}" for i in range(npods)))
+    sys.exit(0)
+
+if "get" in argv and "pod" in argv:
+    print("Succeeded", end="")
+    sys.exit(0)
+
+if "logs" in argv:
+    pod = argv[-1]
+    m = re.match(r"(tpu-bench[\w-]*?)-(\d+)$", pod)
+    if m is None:
+        sys.exit(0)
+    index = int(m.group(2))
+    print(f"boot log line rank={index}")
+    if index == 0:
+        result = {
+            "strategy": "ddp", "world_size": 8, "rank": 0, "seq_len": 128,
+            "tier": "S", "steps": 6, "per_device_batch": 1, "grad_accum": 1,
+            "tokens_per_sec": 8000.0, "mean_step_time_sec": 0.128,
+            "mean_loss": 6.0, "peak_vram_gb": 1.0, "h2d_gbps_per_gpu": 1e-5,
+        }
+        print("BENCHMARK_RESULT_JSON_START")
+        print(json.dumps(result, indent=2))
+        print("BENCHMARK_RESULT_JSON_END")
+    sys.exit(0)
+
+if "delete" in argv:
+    print("deleted")
+    sys.exit(0)
+
+sys.exit(0)
+'''
+
+
+@pytest.fixture()
+def fake_kubectl(tmp_path):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    kubectl = bindir / "kubectl"
+    kubectl.write_text(FAKE_KUBECTL)
+    kubectl.chmod(kubectl.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ)
+    env["PATH"] = f"{bindir}:{env['PATH']}"
+    env["FAKE_KUBECTL_DIR"] = str(tmp_path)
+    return env, tmp_path
+
+
+def test_launch_renders_two_host_manifest(fake_kubectl):
+    """--num-hosts 2 with --world-size 8: Indexed Job gets completions=
+    parallelism=2, 4 chips per host, NUM_PROCESSES=2, and the coordinator
+    DNS is pod 0 of the job under the headless-service subdomain."""
+    env, tmp = fake_kubectl
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "launch_multi.sh"),
+         "--strategy", "ddp", "--world-size", "8", "--num-hosts", "2",
+         "--seq-len", "128", "--tier", "S", "--steps", "6",
+         "--job-name", "tpu-bench-mh"],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    m = (tmp / "manifest_tpu-bench-mh.yaml").read_text()
+    assert "completions: 2" in m
+    assert "parallelism: 2" in m
+    assert "google.com/tpu: 4" in m  # chips per host = world / hosts
+    # env contract for every indexed pod
+    assert '"8"' in m.split("WORLD_SIZE", 1)[1][:60]
+    assert '"2"' in m.split("NUM_PROCESSES", 1)[1][:60]
+    # coordinator: completion-index-0 pod DNS under the headless subdomain
+    assert "tpu-bench-mh-0.tpu-bench.bench.svc.cluster.local" in m
+    assert "subdomain: tpu-bench" in m
+    live = "\n".join(
+        l for l in m.splitlines() if not l.lstrip().startswith("#")
+    )
+    assert "{{" not in live
+
+
+def test_launch_rejects_indivisible_hosts(fake_kubectl):
+    env, _ = fake_kubectl
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "launch_multi.sh"),
+         "--strategy", "ddp", "--world-size", "8", "--num-hosts", "3",
+         "--job-name", "tpu-bench-bad"],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert proc.returncode != 0
+    assert "not divisible" in proc.stdout + proc.stderr
+
+
+def test_collect_merges_logs_from_all_pods(fake_kubectl, tmp_path):
+    """collect_results.sh --k8s saves every pod's log (rank>0 logs are the
+    rendezvous diagnostics) and extracts the result from the one pod that
+    printed the markers."""
+    env, _ = fake_kubectl
+    out = tmp_path / "collected"
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "collect_results.sh"),
+         "--k8s", "bench", "tpu-bench-mh", str(out)],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    logs = sorted(f for f in os.listdir(out) if f.endswith(".log"))
+    assert logs == ["tpu-bench-mh-0.log", "tpu-bench-mh-1.log"]
+    assert "rank=1" in (out / "tpu-bench-mh-1.log").read_text()
+    r = json.loads((out / "tpu-bench-mh_results" / "result.json").read_text())
+    assert r["world_size"] == 8 and r["rank"] == 0
+
+
+def test_collect_fails_when_no_pod_has_markers(fake_kubectl, tmp_path):
+    """All pods died before final metrics -> loud failure, logs still saved."""
+    env, tmpdir = fake_kubectl
+    env = dict(env)
+    env["FAKE_NUM_PODS"] = "2"
+
+    # Point the job name at a pattern the fake kubectl serves markerless:
+    # patch by renaming — easiest is a job whose pod-0 log has no markers.
+    # The stub prints markers only for index 0 of tpu-bench-* jobs, so use a
+    # second stub behavior: FAKE_NO_MARKERS suppresses them.
+    kubectl = tmpdir / "bin" / "kubectl"
+    kubectl.write_text(
+        kubectl.read_text().replace("if index == 0:", "if False:")
+    )
+    out = tmp_path / "collected"
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "collect_results.sh"),
+         "--k8s", "bench", "tpu-bench-mh", str(out)],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert proc.returncode != 0
+    assert "no result JSON" in proc.stderr
+    assert sorted(f for f in os.listdir(out) if f.endswith(".log")) == [
+        "tpu-bench-mh-0.log", "tpu-bench-mh-1.log",
+    ]
+
+
+def test_entrypoint_num_processes_passthrough(tmp_path):
+    """NUM_PROCESSES (hosts) reaches the harness as --num-processes, with
+    rank from the completion index — the pod-slice process contract."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    capture = tmp_path / "argv.txt"
+    stub = bindir / "python"
+    stub.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        if [ "$1" = "-" ]; then cat > /dev/null; exit 0; fi
+        echo "$@" > {capture}
+        exit 0
+        """))
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    env = {
+        "PATH": f"{bindir}:{os.environ['PATH']}",
+        "HOME": os.environ.get("HOME", "/tmp"),
+        "WORLD_SIZE": "8", "NUM_PROCESSES": "2",
+        "JOB_COMPLETION_INDEX": "1",
+        "MASTER_ADDR": "tpu-bench-mh-0.tpu-bench.bench.svc.cluster.local",
+    }
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "docker", "entrypoint.sh")],
+        capture_output=True, text=True, env=env, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    joined = " ".join(capture.read_text().split())
+    assert "--world-size 8" in joined
+    assert "--num-processes 2" in joined
+    assert "--rank 1" in joined
+    assert (
+        "--master-addr tpu-bench-mh-0.tpu-bench.bench.svc.cluster.local"
+        in joined
+    )
