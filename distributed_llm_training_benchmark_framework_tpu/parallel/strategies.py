@@ -70,6 +70,14 @@ class StrategyConfig:
     remat: str = "none"
     # compute precision for matmuls ('bf16' | 'f32')
     precision: str = "bf16"
+    # parameter (and therefore Adam-state) storage dtype: 'f32' (default —
+    # fp32 master weights, the training-quality choice) or 'bf16', which
+    # halves params+grads+moments. bf16 state is what makes tier B (1.68B
+    # params, ~25 GiB of fp32 state) runnable on a single 16 GiB chip —
+    # DeepSpeed's fp16 master-weightless mode plays the same role. Expect
+    # bf16-rounded Adam updates (a stress-tier trade, documented in
+    # docs/TROUBLESHOOTING.md).
+    param_dtype: str = "f32"
 
     def describe(self) -> str:
         bits = [
@@ -79,6 +87,8 @@ class StrategyConfig:
         ]
         if self.remat != "none":
             bits.append(f"remat={self.remat}")
+        if self.param_dtype != "f32":
+            bits.append(f"param_dtype={self.param_dtype}")
         return f"{self.name}: " + ", ".join(bits)
 
 
@@ -156,6 +166,12 @@ def load_strategy_config(path: str) -> StrategyConfig:
     opt = raw.get("optimizer", {})
     sched = raw.get("scheduler", {})
     shard = raw.get("sharding", {})
+    pdtype = raw.get("param_dtype", base.param_dtype)
+    if pdtype not in ("f32", "bf16"):
+        raise ValueError(
+            f"invalid param_dtype {pdtype!r} in strategy config "
+            "(expected 'f32' or 'bf16')"
+        )
     return dataclasses.replace(
         base,
         learning_rate=float(opt.get("lr", base.learning_rate)),
@@ -165,6 +181,7 @@ def load_strategy_config(path: str) -> StrategyConfig:
         warmup_steps=int(sched.get("warmup_steps", base.warmup_steps)),
         grad_clip=raw.get("grad_clip", base.grad_clip),
         precision=raw.get("precision", base.precision),
+        param_dtype=pdtype,
         shard_params=bool(shard.get("params", base.shard_params)),
         shard_grads=bool(shard.get("grads", base.shard_grads)),
         shard_opt_state=bool(shard.get("opt_state", base.shard_opt_state)),

@@ -71,11 +71,43 @@ class BenchmarkCheckpointer:
         )
         if saved:
             self.manager.wait_until_finished()
-            # Always rewrite: a stale tag from a previous run in a reused
-            # directory would mis-label these checkpoints.
-            with open(self._layout_path, "w") as f:
-                json.dump(self.layout, f)
+            existing = self._read_layout()
+            if existing is None:
+                with open(self._layout_path, "w") as f:
+                    json.dump(self.layout, f)
+            elif existing != self.layout:
+                # A directory already holding checkpoints of a DIFFERENT
+                # layout must not be silently mixed — latest_step() could
+                # later resume the other run's permuted state under this
+                # run's tag. Fail loudly at the first save instead.
+                raise ValueError(
+                    f"checkpoint directory {self.directory} holds "
+                    f"checkpoints with parameter layout {existing}, but this "
+                    f"run writes {self.layout}; refusing to mix layouts in "
+                    "one directory — use a fresh --checkpoint-dir."
+                )
         return bool(saved)
+
+    def _read_layout(self) -> Optional[Dict[str, Any]]:
+        """The directory's layout tag, normalized; None if absent."""
+        if not os.path.exists(self._layout_path):
+            return None
+        with open(self._layout_path) as f:
+            raw = json.load(f)
+        if "layer_layout" in raw:
+            return raw
+        # One earlier tag format recorded {"pipeline_schedule", "virtual_
+        # stages"} instead of the physical layout; translate. (pp was not
+        # recorded, so an old interleaved tag maps to a wildcard that only
+        # matches an interleaved run with the same V.)
+        ps = raw.get("pipeline_schedule", "none")
+        if ps == "interleaved":
+            v = raw.get("virtual_stages", 2)
+            cur = self.layout.get("layer_layout", "")
+            if cur.startswith("interleaved:") and cur.endswith(f":v={v}"):
+                return dict(self.layout)
+            return {"layer_layout": f"interleaved:pp=?:v={v}"}
+        return {"layer_layout": "contiguous"}
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
@@ -87,10 +119,8 @@ class BenchmarkCheckpointer:
         step = self.manager.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self.directory}")
-        if os.path.exists(self._layout_path):
-            with open(self._layout_path) as f:
-                saved_layout = json.load(f)
-        else:
+        saved_layout = self._read_layout()
+        if saved_layout is None:
             # Pre-tag checkpoints were always written in the contiguous
             # layout (the tag shipped together with the interleaved schedule).
             saved_layout = {"layer_layout": "contiguous"}

@@ -122,6 +122,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's per-step discipline, N>1 keeps host RPC "
                         "latency out of the timed loop on slow host links")
     # Configs
+    p.add_argument("--param-dtype", choices=["f32", "bf16"], default=None,
+                   help="Parameter/Adam-state storage dtype (default: the "
+                        "arm's config, normally f32 master weights). bf16 "
+                        "halves params+grads+moments — the knob that fits "
+                        "tier B (1.68B, ~25 GiB fp32 state) on one 16 GiB "
+                        "chip, at bf16-rounded-update precision")
     p.add_argument("--strategy-config", type=str, default=None,
                    help="Path to a configs/strategies/*.json file")
     p.add_argument("--deepspeed-config", type=str, default=None,
@@ -197,6 +203,10 @@ def main(argv=None) -> int:
         enable_debug()
 
     strategy = resolve_strategy(args)
+    if args.param_dtype is not None:
+        import dataclasses as _dc
+
+        strategy = _dc.replace(strategy, param_dtype=args.param_dtype)
     dist.setup_distributed(
         master_addr=args.master_addr,
         master_port=args.master_port,

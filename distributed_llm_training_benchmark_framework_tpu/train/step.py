@@ -78,8 +78,15 @@ def _resolve_model_config(
     # (utils.memory.resolve_auto_remat); a direct create_train_state caller
     # that skips that step gets the conservative policy.
     remat = "full" if strategy.remat == "auto" else strategy.remat
+    # bf16 parameter storage halves params+grads+Adam state — the knob that
+    # fits tier B on one chip (see StrategyConfig.param_dtype).
+    param_dtype = (
+        jnp.bfloat16 if getattr(strategy, "param_dtype", "f32") == "bf16"
+        else jnp.float32
+    )
     return dataclasses.replace(
-        model_config, remat=remat, compute_dtype=compute_dtype
+        model_config, remat=remat, compute_dtype=compute_dtype,
+        param_dtype=param_dtype,
     )
 
 
@@ -199,8 +206,14 @@ def make_train_step(
             loss, grads = jax.value_and_grad(micro_loss)(params, batch[0], key)
         else:
             keys = jax.random.split(base_key, grad_accum)
+            # Accumulator dtype follows the parameter dtype (cotangents
+            # arrive in it anyway): fp32 for fp32 master weights — the
+            # default, full-precision accumulation — and bf16 under
+            # --param-dtype bf16, where fp32 accumulators alone would add a
+            # params-sized 2x buffer and defeat the option's purpose (tier B
+            # on one chip).
             zero_grads = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params
+                lambda p: jnp.zeros(p.shape, p.dtype), params
             )
             (loss_sum, grads), _ = lax.scan(
                 one_micro, (jnp.zeros((), jnp.float32), zero_grads), (batch, keys)

@@ -58,7 +58,7 @@ N_TPU_NODES=$(echo "$NODES" | jq '[.items[]
 [ "$N_TPU_NODES" -eq 0 ] && FAIL=1
 
 TOTAL=$(echo "$NODES" | jq '[.items[]
-  | .status.allocatable["google.com/tpu"] // "0" | tonumber] | add')
+  | .status.allocatable["google.com/tpu"] // "0" | tonumber] | add // 0')
 IN_USE=$(echo "$USED_BY_NODE" | jq '[.[]] | add // 0')
 FREE=$(( ${TOTAL:-0} - ${IN_USE:-0} ))
 echo ""
