@@ -61,6 +61,19 @@ class BenchmarkCheckpointer:
         return self.save_every > 0 and step > 0 and step % self.save_every == 0
 
     def save(self, step: int, params: Any, opt_state: Any, force: bool = False) -> bool:
+        # Check the directory's layout BEFORE persisting anything: a save
+        # into a directory holding checkpoints of a DIFFERENT layout must
+        # not write first and complain after — that would itself create the
+        # mixed-layout state (latest_step() could later resume the other
+        # run's permuted weights under this run's tag).
+        existing = self._read_layout()
+        if existing is not None and existing != self.layout:
+            raise ValueError(
+                f"checkpoint directory {self.directory} holds checkpoints "
+                f"with parameter layout {existing}, but this run writes "
+                f"{self.layout}; refusing to mix layouts in one directory "
+                "— use a fresh --checkpoint-dir."
+            )
         saved = self.manager.save(
             step,
             args=self._ocp.args.Composite(
@@ -71,21 +84,9 @@ class BenchmarkCheckpointer:
         )
         if saved:
             self.manager.wait_until_finished()
-            existing = self._read_layout()
             if existing is None:
                 with open(self._layout_path, "w") as f:
                     json.dump(self.layout, f)
-            elif existing != self.layout:
-                # A directory already holding checkpoints of a DIFFERENT
-                # layout must not be silently mixed — latest_step() could
-                # later resume the other run's permuted state under this
-                # run's tag. Fail loudly at the first save instead.
-                raise ValueError(
-                    f"checkpoint directory {self.directory} holds "
-                    f"checkpoints with parameter layout {existing}, but this "
-                    f"run writes {self.layout}; refusing to mix layouts in "
-                    "one directory — use a fresh --checkpoint-dir."
-                )
         return bool(saved)
 
     def _read_layout(self) -> Optional[Dict[str, Any]]:
@@ -97,15 +98,15 @@ class BenchmarkCheckpointer:
         if "layer_layout" in raw:
             return raw
         # One earlier tag format recorded {"pipeline_schedule", "virtual_
-        # stages"} instead of the physical layout; translate. (pp was not
-        # recorded, so an old interleaved tag maps to a wildcard that only
-        # matches an interleaved run with the same V.)
+        # stages"} instead of the physical layout; translate. pp was not
+        # recorded, and layer_permutation depends on it, so an old
+        # interleaved tag maps to a wildcard that NEVER matches — same-V
+        # different-pp would corrupt silently if assumed equal. The
+        # resulting loud mismatch tells the operator to keep using the
+        # original code version for that directory or start fresh.
         ps = raw.get("pipeline_schedule", "none")
         if ps == "interleaved":
             v = raw.get("virtual_stages", 2)
-            cur = self.layout.get("layer_layout", "")
-            if cur.startswith("interleaved:") and cur.endswith(f":v={v}"):
-                return dict(self.layout)
             return {"layer_layout": f"interleaved:pp=?:v={v}"}
         return {"layer_layout": "contiguous"}
 

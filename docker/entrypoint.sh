@@ -46,6 +46,16 @@ export ATTENTION="${ATTENTION:-reference}"
 export LAYER_LOOP="${LAYER_LOOP:-scan}"
 export SYNTHETIC="${SYNTHETIC:-true}"
 export RESULTS_DIR="${RESULTS_DIR:-/results}"
+# Extended axes (defaults = off); set via pod env overlays for composition
+# runs — every accepted knob is live (no inert flags).
+export TENSOR_PARALLEL="${TENSOR_PARALLEL:-1}"
+export SEQUENCE_PARALLEL="${SEQUENCE_PARALLEL:-1}"
+export PIPELINE_PARALLEL="${PIPELINE_PARALLEL:-1}"
+export PIPELINE_SCHEDULE="${PIPELINE_SCHEDULE:-gpipe}"
+export VIRTUAL_STAGES="${VIRTUAL_STAGES:-2}"
+export EXPERT_PARALLEL="${EXPERT_PARALLEL:-1}"
+export NUM_EXPERTS="${NUM_EXPERTS:-0}"
+export PARAM_DTYPE="${PARAM_DTYPE:-}"
 
 echo "Config:"
 for v in STRATEGY WORLD_SIZE NUM_PROCESSES RANK MASTER_ADDR MASTER_PORT \
@@ -70,6 +80,22 @@ ARGS="${ARGS} --warmup-steps ${WARMUP_STEPS}"
 ARGS="${ARGS} --per-device-batch ${PER_DEVICE_BATCH} --grad-accum ${GRAD_ACCUM}"
 ARGS="${ARGS} --attention ${ATTENTION} --layer-loop ${LAYER_LOOP}"
 ARGS="${ARGS} --results-dir ${RESULTS_DIR}"
+if [ "${TENSOR_PARALLEL}" != "1" ]; then
+  ARGS="${ARGS} --tensor-parallel ${TENSOR_PARALLEL}"; fi
+if [ "${SEQUENCE_PARALLEL}" != "1" ]; then
+  ARGS="${ARGS} --sequence-parallel ${SEQUENCE_PARALLEL}"; fi
+if [ "${PIPELINE_PARALLEL}" != "1" ]; then
+  ARGS="${ARGS} --pipeline-parallel ${PIPELINE_PARALLEL}"
+  ARGS="${ARGS} --pipeline-schedule ${PIPELINE_SCHEDULE}"
+  if [ "${PIPELINE_SCHEDULE}" = "interleaved" ]; then
+    ARGS="${ARGS} --virtual-stages ${VIRTUAL_STAGES}"; fi
+fi
+if [ "${EXPERT_PARALLEL}" != "1" ]; then
+  ARGS="${ARGS} --expert-parallel ${EXPERT_PARALLEL}"; fi
+if [ "${NUM_EXPERTS}" != "0" ]; then
+  ARGS="${ARGS} --num-experts ${NUM_EXPERTS}"; fi
+if [ -n "${PARAM_DTYPE}" ]; then
+  ARGS="${ARGS} --param-dtype ${PARAM_DTYPE}"; fi
 if [[ "${SYNTHETIC}" == "true" ]]; then ARGS="${ARGS} --synthetic"; fi
 if [[ "${STRATEGY}" == "zero2" || "${STRATEGY}" == "zero3" ]]; then
   ARGS="${ARGS} --strategy-config /app/configs/strategies/${STRATEGY}.json"

@@ -218,3 +218,50 @@ def test_entrypoint_num_processes_passthrough(tmp_path):
         "--master-addr tpu-bench-mh-0.tpu-bench.bench.svc.cluster.local"
         in joined
     )
+
+
+def test_entrypoint_extended_axes_passthrough(tmp_path):
+    """The extended-axis env knobs reach the harness CLI; defaults add no
+    flags (the parity arms' argv stays identical to before)."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    capture = tmp_path / "argv.txt"
+    stub = bindir / "python"
+    stub.write_text(textwrap.dedent(f"""\
+        #!/bin/sh
+        if [ "$1" = "-" ]; then cat > /dev/null; exit 0; fi
+        echo "$@" > {capture}
+        exit 0
+        """))
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    base_env = {
+        "PATH": f"{bindir}:{os.environ['PATH']}",
+        "HOME": os.environ.get("HOME", "/tmp"),
+    }
+
+    def run(extra):
+        env = dict(base_env)
+        env.update(extra)
+        proc = subprocess.run(
+            ["bash", os.path.join(REPO, "docker", "entrypoint.sh")],
+            capture_output=True, text=True, env=env, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        return " ".join(capture.read_text().split())
+
+    plain = run({})
+    for flag in ("--tensor-parallel", "--pipeline-parallel",
+                 "--expert-parallel", "--param-dtype", "--num-experts"):
+        assert flag not in plain
+
+    full = run({
+        "PIPELINE_PARALLEL": "2", "PIPELINE_SCHEDULE": "interleaved",
+        "VIRTUAL_STAGES": "4", "TENSOR_PARALLEL": "2",
+        "SEQUENCE_PARALLEL": "2", "EXPERT_PARALLEL": "2",
+        "NUM_EXPERTS": "8", "PARAM_DTYPE": "bf16",
+    })
+    for part in ("--pipeline-parallel 2", "--pipeline-schedule interleaved",
+                 "--virtual-stages 4", "--tensor-parallel 2",
+                 "--sequence-parallel 2", "--expert-parallel 2",
+                 "--num-experts 8", "--param-dtype bf16"):
+        assert part in full, (part, full)
