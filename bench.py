@@ -95,6 +95,13 @@ def main():
             result.model_tflops_per_sec_per_chip, 2
         ),
         "mfu_pct": round(result.mfu_pct, 2),
+        # Measured peak device memory (allocator or XLA buffer-assignment;
+        # see utils/metrics.measure_peak_hbm) with its provenance.
+        "peak_hbm_gb": round(result.peak_hbm_gb, 2),
+        "peak_hbm_method": result.peak_hbm_method,
+        "tokens_per_dollar": (
+            round(result.tokens_per_dollar) if result.tokens_per_dollar else None
+        ),
     }))
 
 
