@@ -40,6 +40,20 @@ WORLD_SIZES="${WORLD_SIZES:-}"
 NAMESPACE="${NAMESPACE:-bench}"
 IMAGE="${IMAGE:-}"
 TIMEOUT_PER_RUN="${TIMEOUT_PER_RUN:-1800}"
+# Extra harness flags appended to every local run — the hook for composition
+# arms the fixed matrix doesn't enumerate, e.g.
+#   EXTRA_ARGS="--pipeline-parallel 2 --pipeline-schedule interleaved"
+#   EXTRA_ARGS="--param-dtype bf16"   (with TIER=B)
+# Space-separated (values must not themselves contain spaces or glob chars —
+# it is an env string, not an array). Run names get a slug of these flags
+# (override with RUN_SUFFIX) so composition arms never overwrite the
+# baseline arms' results in a shared RESULTS_DIR — the same collision the
+# -flash suffix prevents for ATTENTION.
+EXTRA_ARGS="${EXTRA_ARGS:-}"
+RUN_SUFFIX="${RUN_SUFFIX:-}"
+if [ -n "$EXTRA_ARGS" ] && [ -z "$RUN_SUFFIX" ]; then
+  RUN_SUFFIX=$(echo "$EXTRA_ARGS" | tr -cs 'a-zA-Z0-9' '-' | sed 's/^-*//; s/-*$//')
+fi
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -81,6 +95,7 @@ run_local() {
   local strategy="$1" ws="$2"
   local name="bench-${strategy}-ws${ws}-seq${SEQ_LEN}"
   [ "$ATTENTION" != "reference" ] && name="${name}-${ATTENTION}"
+  [ -n "$RUN_SUFFIX" ] && name="${name}-${RUN_SUFFIX}"
   local log="$RESULTS_DIR/${name}.log"
   echo "--- $name ---"
   local t0=$(date +%s)
@@ -91,6 +106,7 @@ run_local() {
       --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
       --sync-every "$SYNC_EVERY" --layer-loop "$LAYER_LOOP" \
       --results-dir "$RESULTS_DIR/${name}_results" \
+      $EXTRA_ARGS \
       > "$log" 2>&1; then
     scripts/collect_results.sh --log "$log" "$RESULTS_DIR/${name}_results" \
       || true
