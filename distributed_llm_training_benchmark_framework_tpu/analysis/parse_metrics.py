@@ -56,6 +56,13 @@ def load_results(results_dir: str) -> pd.DataFrame:
         c for c in (
             "strategy", "world_size", "seq_len", "tier", "rank",
             "per_device_batch", "grad_accum", "steps", "attention_impl",
+            # Composition axes: a pipeline/TP/SP/MoE/bf16 arm is a DIFFERENT
+            # run from the baseline with the same batch geometry — without
+            # these in the key, a composition suite sharing RESULTS_DIR with
+            # a baseline suite would dedupe one of them away.
+            "tensor_parallel", "sequence_parallel", "pipeline_parallel",
+            "pipeline_schedule", "virtual_stages", "expert_parallel",
+            "n_experts", "remat_policy",
         ) if c in df.columns
     ]
     df = df.drop_duplicates(subset=key, keep="first")
@@ -71,12 +78,20 @@ def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
     world size — never a different kernel's throughput.
     """
     group_cols = ["strategy", "seq_len"] + [
-        c for c in ("tier", "per_device_batch", "grad_accum", "attention_impl")
+        c for c in (
+            "tier", "per_device_batch", "grad_accum", "attention_impl",
+            "tensor_parallel", "sequence_parallel", "pipeline_parallel",
+            "pipeline_schedule", "virtual_stages", "expert_parallel",
+            "n_experts",
+        )
         if c in df.columns
     ]
     df = df.copy()
     df["scaling_efficiency_pct"] = 0.0
-    for _, group in df.groupby(group_cols):
+    # dropna=False: rows from before a schema addition carry NaN in the
+    # newer axis columns and must still get their efficiency computed
+    # (pandas silently drops NaN-keyed groups by default).
+    for _, group in df.groupby(group_cols, dropna=False):
         base = group.loc[group["world_size"].idxmin()]
         for i in group.index:
             row = df.loc[i]

@@ -68,6 +68,14 @@ while [ $# -gt 0 ]; do
   esac
 done
 
+if [ "$MODE" = "k8s" ] && [ -n "$EXTRA_ARGS" ]; then
+  # launch_multi.sh/the job template don't carry arbitrary flags; silently
+  # running f32 baselines when the operator asked for a composition arm
+  # would mislabel every scraped result.
+  echo "ERROR: EXTRA_ARGS is local-mode only (set the pod env knobs in" \
+       "docker/entrypoint.sh for k8s composition runs)"; exit 1
+fi
+
 mkdir -p "$RESULTS_DIR"
 
 if [ -z "$WORLD_SIZES" ]; then

@@ -86,3 +86,33 @@ def test_zero_arm_requires_no_explicit_config(smoke_run):
     """Default configs/strategies/zero2.json was auto-resolved (and is live)."""
     proc, _ = smoke_run
     assert proc.returncode == 0
+
+
+def test_harness_interleaved_cli(tmp_path):
+    """CLI -> interleaved schedule e2e: --pipeline-schedule interleaved with
+    --virtual-stages reaches the executor (schedule fields land in the
+    result JSON) and trains. V=1 because tier S has 2 layers = pipe * V."""
+    results = tmp_path / "results"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [
+            sys.executable, "-u",
+            os.path.join(REPO, "benchmarking", "train_harness.py"),
+            "--strategy", "ddp", "--world-size", "4", "--rank", "0",
+            "--tier", "S", "--seq-len", "64", "--steps", "6",
+            "--warmup-steps", "1", "--per-device-batch", "2",
+            "--grad-accum", "4", "--pipeline-parallel", "2",
+            "--pipeline-schedule", "interleaved", "--virtual-stages", "1",
+            "--results-dir", str(results),
+        ],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    r = json.loads((results / "result_ddp_ws4_seq64_tierS.json").read_text())
+    assert r["pipeline_parallel"] == 2
+    assert r["pipeline_schedule"] == "interleaved"
+    assert r["virtual_stages"] == 1
+    assert r["tokens_per_sec"] > 0
+    assert 0 < r["mean_loss"] < 7
