@@ -47,7 +47,21 @@ TPU-native construction (nothing like Megatron's process-per-stage runtime):
 Constraints: n_layer % (pipe * virtual) == 0; dense blocks only (MoE's aux
 cotangent is wired through gpipe/1f1b — compose MoE with those schedules).
 Sequence parallelism composes the same way as the other schedules (manual
-over ('pipe','seq'), sharded ring/Ulysses attention, CE psum over 'seq').
+over ('pipe','seq'), sharded ring/Ulysses attention, CE psum over 'seq') —
+with one backend-specific execution detail. With sp>1 the unit bodies
+contain 'seq'-axis collectives, and the per-tick ``lax.switch`` index varies
+across pipe stages. Each 'seq' collective's participants all share a pipe
+stage, so every participant takes the same branch — uniform-across-
+participants, which is what the SPMD model requires — but XLA:CPU's thunk
+runtime rendezvouses ALL local devices per collective instruction, so pipe
+stage 0 sitting in the FWD branch's ring ppermute while stage 1 sits in the
+BWD branch's CE psum aborts the process (rendezvous timeout, observed as
+SIGABRT with "Expected 4 threads to join the rendezvous, but only 2
+arrived"). On CPU with sp>1 the executor therefore runs every unit kind
+unconditionally and selects outputs by mask — one uniform collective
+sequence on every device, at the price of ~2-3x per-tick compute. That
+price is paid only where it buys testability; the TPU path keeps the
+single-unit switch.
 """
 
 from __future__ import annotations
@@ -305,6 +319,10 @@ def interleaved_loss_and_grads(
             "pipeline_schedule gpipe or 1f1b for MoE x pp"
         )
     config, seq_ax, sp, manual_axes, batch_spec = _seq_setup(config, mesh)
+    # See the module docstring: XLA:CPU's collective rendezvous spans all
+    # local devices per instruction, so 'seq' collectives inside the
+    # device-varying switch deadlock there. Run all unit kinds and mask.
+    uniform_units = sp > 1 and jax.default_backend() == "cpu"
     PV = n_stages * V
     Lc = config.n_layer // PV
     n_micro = batch.shape[0]
@@ -472,7 +490,15 @@ def interleaved_loss_and_grads(
                     d_blk, d_x = vjp(g_parked)
                     return zl, d_blk, zh, d_x
 
-                l, d_blk, d_hp_t, d_x = lax.cond(is_head, head_vjp, plain_vjp)
+                if uniform_units:
+                    l, d_blk, d_hp_t, d_x = jax.tree.map(
+                        lambda h, p: jnp.where(is_head, h, p),
+                        head_vjp(), plain_vjp(),
+                    )
+                else:
+                    l, d_blk, d_hp_t, d_x = lax.cond(
+                        is_head, head_vjp, plain_vjp
+                    )
 
                 # Position 0's input cotangent belongs to the embedding
                 # (compute-and-mask: embed is cheap, and ep_in is pre-cast
@@ -492,9 +518,20 @@ def interleaved_loss_and_grads(
             def idle_unit():
                 return (resid, zero_out, zero_out, zb, zh, ze, zl)
 
-            (resid, f_out, b_out, d_blk_t, d_hp_t, d_ep_t, l_t) = lax.switch(
-                t["kind"], [idle_unit, f_unit, b_unit]
-            )
+            if uniform_units:
+                k = t["kind"]
+                (resid, f_out, b_out, d_blk_t, d_hp_t, d_ep_t, l_t) = (
+                    jax.tree.map(
+                        lambda i, f, b: jnp.where(
+                            k == FWD, f, jnp.where(k == BWD, b, i)
+                        ),
+                        idle_unit(), f_unit(), b_unit(),
+                    )
+                )
+            else:
+                (resid, f_out, b_out, d_blk_t, d_hp_t, d_ep_t, l_t) = (
+                    lax.switch(t["kind"], [idle_unit, f_unit, b_unit])
+                )
             d_blocks = chunk_update_add(d_blocks, d_blk_t, v_s)
             d_hp = jax.tree.map(jnp.add, d_hp, d_hp_t)
             d_ep = jax.tree.map(jnp.add, d_ep, d_ep_t)

@@ -67,13 +67,57 @@ class BenchmarkCheckpointer:
         # mixed-layout state (latest_step() could later resume the other
         # run's permuted weights under this run's tag).
         existing = self._read_layout()
+        # None here means absent OR unparseable-over-empty-dir (treated as
+        # absent): either way the tag needs (re)stamping below — keying the
+        # stamp on file existence instead would leave a truncated tag in
+        # place forever while checkpoints commit behind it.
+        needs_stamp = existing is None
+        has_steps = self.manager.latest_step() is not None
+        if existing is None and has_steps:
+            # Pre-tag checkpoints exist but no layout.json: those steps were
+            # always written contiguous (the tag shipped with the interleaved
+            # schedule) — the same assumption restore() makes. Without this a
+            # permuted-layout run could save into such a directory and then
+            # stamp its own tag, retroactively mislabeling the old contiguous
+            # steps so restore(step=<old>) loads layers at the wrong depth.
+            existing = {"layer_layout": "contiguous"}
         if existing is not None and existing != self.layout:
+            if not has_steps:
+                # A tag with no checkpoints behind it is usually a stale
+                # leftover (a run killed after stamping but before its
+                # first save committed) — but it could also be a LIVE
+                # sibling run whose first async orbax save hasn't landed
+                # yet, so silently taking the directory over would
+                # mislabel that run's in-flight checkpoint. Refuse with
+                # the explicit remedy instead.
+                raise ValueError(
+                    f"checkpoint directory {self.directory} carries a "
+                    f"layout tag {existing} but holds no checkpoints; if "
+                    "no other run is writing there, the tag is a stale "
+                    "leftover of an interrupted first save — delete "
+                    f"{self._layout_path} to reclaim the directory, or "
+                    "use a fresh --checkpoint-dir."
+                )
             raise ValueError(
-                f"checkpoint directory {self.directory} holds checkpoints "
-                f"with parameter layout {existing}, but this run writes "
-                f"{self.layout}; refusing to mix layouts in one directory "
-                "— use a fresh --checkpoint-dir."
+                f"checkpoint directory {self.directory} holds "
+                f"checkpoints with parameter layout {existing}, but "
+                f"this run writes {self.layout}; refusing to mix "
+                "layouts in one directory — use a fresh "
+                "--checkpoint-dir."
             )
+        if needs_stamp:
+            # Stamp BEFORE the save commits: a crash between manager.save
+            # and a later stamp would leave committed permuted checkpoints
+            # that the missing-tag-means-contiguous inference above (and
+            # restore()'s) would then permanently misclassify, locking the
+            # run out of its own directory. Stamp-then-crash-before-save
+            # is the benign order (tag over an empty directory, loudly
+            # reclaimable above). Write-rename so a crash mid-write can't
+            # leave a truncated tag.
+            tmp = self._layout_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.layout, f)
+            os.replace(tmp, self._layout_path)
         saved = self.manager.save(
             step,
             args=self._ocp.args.Composite(
@@ -84,17 +128,30 @@ class BenchmarkCheckpointer:
         )
         if saved:
             self.manager.wait_until_finished()
-            if existing is None:
-                with open(self._layout_path, "w") as f:
-                    json.dump(self.layout, f)
         return bool(saved)
 
     def _read_layout(self) -> Optional[Dict[str, Any]]:
         """The directory's layout tag, normalized; None if absent."""
         if not os.path.exists(self._layout_path):
             return None
-        with open(self._layout_path) as f:
-            raw = json.load(f)
+        try:
+            with open(self._layout_path) as f:
+                raw = json.load(f)
+        except ValueError:
+            # Our writes are write-rename atomic, so an unparseable tag
+            # means an external writer or a pre-atomic-write version. With
+            # no checkpoints behind it nothing can be mislabeled — treat as
+            # absent; with committed steps the layout is unknowable, so
+            # fail with the remedy rather than guess.
+            if self.manager.latest_step() is None:
+                return None
+            raise ValueError(
+                f"unparseable layout tag {self._layout_path} over a "
+                "directory that holds checkpoints; cannot determine their "
+                "parameter layout. Restore the tag (e.g. "
+                '{"layer_layout": "contiguous"} for pre-interleaved '
+                "checkpoints) or move the checkpoints aside."
+            )
         if "layer_layout" in raw:
             return raw
         # One earlier tag format recorded {"pipeline_schedule", "virtual_

@@ -130,3 +130,76 @@ def test_layout_mismatch_refused(tmp_path):
     with pytest.raises(ValueError, match="layout"):
         bad.restore(state.params, state.opt_state)
     bad.close()
+
+
+def test_save_into_pretag_dir_refuses_mislabel(tmp_path):
+    """SAVE into a pre-tag directory (checkpoints exist, no layout.json) must
+    treat those steps as contiguous — an interleaved run saving there would
+    otherwise stamp its own tag and retroactively mislabel the old contiguous
+    steps, so restore(step=<old>) would load layers at the wrong depth."""
+    import os as _os
+
+    state = make_state()
+    d = str(tmp_path / "pretag")
+    legacy = BenchmarkCheckpointer(d)
+    legacy.save(1, state.params, state.opt_state)
+    legacy.close()
+    _os.remove(_os.path.join(d, "layout.json"))
+
+    perm = BenchmarkCheckpointer(
+        d, layout={"layer_layout": "interleaved:pp=2:v=2"}
+    )
+    with pytest.raises(ValueError, match="layout"):
+        perm.save(2, state.params, state.opt_state)
+    # No tag was stamped by the refused save.
+    assert not _os.path.exists(_os.path.join(d, "layout.json"))
+    perm.close()
+
+    # A contiguous run MAY save there (same layout the old steps have) and
+    # makes the directory explicit by stamping the tag.
+    cont = BenchmarkCheckpointer(d)
+    assert cont.save(2, state.params, state.opt_state)
+    assert _os.path.exists(_os.path.join(d, "layout.json"))
+    cont.restore(state.params, state.opt_state, step=1)
+    cont.close()
+
+    # A mismatched tag with NO checkpoints behind it (run killed after
+    # stamping, before its first save committed — or a sibling run whose
+    # first async save hasn't landed) is refused LOUDLY with the remedy;
+    # deleting the tag reclaims the directory.
+    import json as _json
+
+    d3 = str(tmp_path / "stale")
+    _os.makedirs(d3)
+    with open(_os.path.join(d3, "layout.json"), "w") as f:
+        _json.dump({"layer_layout": "interleaved:pp=2:v=2"}, f)
+    takeover = BenchmarkCheckpointer(d3)
+    with pytest.raises(ValueError, match="stale"):
+        takeover.save(1, state.params, state.opt_state)
+    _os.remove(_os.path.join(d3, "layout.json"))
+    assert takeover.save(1, state.params, state.opt_state)
+    with open(_os.path.join(d3, "layout.json")) as f:
+        assert _json.load(f) == {"layer_layout": "contiguous"}
+    takeover.close()
+
+    # A truncated tag (crash mid-write predating the atomic write-rename)
+    # over an EMPTY directory is treated as absent; over committed steps it
+    # fails with the remedy instead of guessing.
+    d4 = str(tmp_path / "trunc")
+    _os.makedirs(d4)
+    with open(_os.path.join(d4, "layout.json"), "w") as f:
+        f.write('{"layer_lay')
+    trunc_ok = BenchmarkCheckpointer(d4)
+    assert trunc_ok.save(1, state.params, state.opt_state)
+    # ... and that save REPAIRED the truncated tag (stamp keys on tag
+    # validity, not file existence), so the run keeps its own directory.
+    with open(_os.path.join(d4, "layout.json")) as f:
+        assert _json.load(f) == {"layer_layout": "contiguous"}
+    assert trunc_ok.save(2, state.params, state.opt_state)
+    trunc_ok.close()
+    with open(_os.path.join(d4, "layout.json"), "w") as f:
+        f.write('{"layer_lay')
+    trunc_bad = BenchmarkCheckpointer(d4)
+    with pytest.raises(ValueError, match="unparseable"):
+        trunc_bad.restore(state.params, state.opt_state)
+    trunc_bad.close()
