@@ -285,6 +285,8 @@ def _attention(
                 q, k, v, axis_name=ax, causal=config.causal,
                 dropout_rate=config.dropout if seed is not None else 0.0,
                 dropout_seed=seed,
+                block_q=config.flash_block_q, block_k=config.flash_block_k,
+                block_k_bwd=config.flash_block_k_bwd,
             )
         if config.attention_impl == "ulysses":
             from ..ops.ulysses_attention import ulysses_attention_sharded
@@ -321,6 +323,8 @@ def _attention(
             q, k, v, causal=config.causal,
             dropout_rate=config.dropout if seed is not None else 0.0,
             dropout_seed=seed,
+            block_q=config.flash_block_q, block_k=config.flash_block_k,
+            block_k_bwd=config.flash_block_k_bwd,
         )
     if config.attention_impl == "ulysses":
         from ..ops.ulysses_attention import ulysses_attention

@@ -103,7 +103,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "(bit-reproducible across backends)")
     p.add_argument("--layer-loop", choices=["scan", "unrolled"], default="scan",
                    help="Transformer layer iteration: lax.scan over stacked "
-                        "weights (fast compile) or an unrolled loop (~15% "
+                        "weights (fast compile) or an unrolled loop (~15%% "
                         "faster single-chip step; slower compile)")
     p.add_argument("--flash-pallas-backward", action="store_true",
                    help="Use the hand-written Pallas backward kernels instead "

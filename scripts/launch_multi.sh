@@ -17,6 +17,16 @@ PER_DEVICE_BATCH=1
 GRAD_ACCUM=4
 ATTENTION="reference"
 LAYER_LOOP="scan"
+# Extended composition axes (docker/entrypoint.sh consumes these as env
+# vars and turns non-default values into harness flags).
+TENSOR_PARALLEL=1
+SEQUENCE_PARALLEL=1
+PIPELINE_PARALLEL=1
+PIPELINE_SCHEDULE="gpipe"
+VIRTUAL_STAGES=2
+EXPERT_PARALLEL=1
+NUM_EXPERTS=0
+PARAM_DTYPE=""
 IMAGE="tpu-llm-bench:latest"
 TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
@@ -35,6 +45,14 @@ while [ $# -gt 0 ]; do
     --grad-accum) GRAD_ACCUM="$2"; shift 2 ;;
     --attention) ATTENTION="$2"; shift 2 ;;
     --layer-loop) LAYER_LOOP="$2"; shift 2 ;;
+    --tensor-parallel) TENSOR_PARALLEL="$2"; shift 2 ;;
+    --sequence-parallel) SEQUENCE_PARALLEL="$2"; shift 2 ;;
+    --pipeline-parallel) PIPELINE_PARALLEL="$2"; shift 2 ;;
+    --pipeline-schedule) PIPELINE_SCHEDULE="$2"; shift 2 ;;
+    --virtual-stages) VIRTUAL_STAGES="$2"; shift 2 ;;
+    --expert-parallel) EXPERT_PARALLEL="$2"; shift 2 ;;
+    --num-experts) NUM_EXPERTS="$2"; shift 2 ;;
+    --param-dtype) PARAM_DTYPE="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
     --job-name) JOB_NAME="$2"; shift 2 ;;
@@ -67,6 +85,14 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{GRAD_ACCUM}}|$GRAD_ACCUM|g" \
     -e "s|{{ATTENTION}}|$ATTENTION|g" \
     -e "s|{{LAYER_LOOP}}|$LAYER_LOOP|g" \
+    -e "s|{{TENSOR_PARALLEL}}|$TENSOR_PARALLEL|g" \
+    -e "s|{{SEQUENCE_PARALLEL}}|$SEQUENCE_PARALLEL|g" \
+    -e "s|{{PIPELINE_PARALLEL}}|$PIPELINE_PARALLEL|g" \
+    -e "s|{{PIPELINE_SCHEDULE}}|$PIPELINE_SCHEDULE|g" \
+    -e "s|{{VIRTUAL_STAGES}}|$VIRTUAL_STAGES|g" \
+    -e "s|{{EXPERT_PARALLEL}}|$EXPERT_PARALLEL|g" \
+    -e "s|{{NUM_EXPERTS}}|$NUM_EXPERTS|g" \
+    -e "s|{{PARAM_DTYPE}}|$PARAM_DTYPE|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \
     -e "s|{{TPU_ACCELERATOR}}|$TPU_ACCELERATOR|g" \
     -e "s|{{TPU_TOPOLOGY}}|$TPU_TOPOLOGY|g" \

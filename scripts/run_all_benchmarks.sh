@@ -54,6 +54,15 @@ RUN_SUFFIX="${RUN_SUFFIX:-}"
 if [ -n "$EXTRA_ARGS" ] && [ -z "$RUN_SUFFIX" ]; then
   RUN_SUFFIX=$(echo "$EXTRA_ARGS" | tr -cs 'a-zA-Z0-9' '-' | sed 's/^-*//; s/-*$//')
 fi
+# Composition roster: when the widest world size can hold a second axis
+# (>= 4 chips: 2-way composition axis x >= 2-way data), the suite
+# auto-appends one run per extended-axis arm at that world size — tensor,
+# pipeline (all three schedules), sequence (ring + Ulysses) and expert
+# parallelism — so ONE invocation on a pod slice produces the complete
+# scaling story, the way the reference hard-codes its full matrix
+# (reference scripts/run_all_benchmarks.sh fixed strategy x gpu grid).
+# COMPOSITIONS=off disables; =only skips the pure-strategy matrix.
+COMPOSITIONS="${COMPOSITIONS:-auto}"
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -100,10 +109,10 @@ PASS=0; FAIL=0
 SUITE_START=$(date +%s)
 
 run_local() {
-  local strategy="$1" ws="$2"
+  local strategy="$1" ws="$2" extra="${3-$EXTRA_ARGS}" suffix="${4-$RUN_SUFFIX}"
   local name="bench-${strategy}-ws${ws}-seq${SEQ_LEN}"
   [ "$ATTENTION" != "reference" ] && name="${name}-${ATTENTION}"
-  [ -n "$RUN_SUFFIX" ] && name="${name}-${RUN_SUFFIX}"
+  [ -n "$suffix" ] && name="${name}-${suffix}"
   local log="$RESULTS_DIR/${name}.log"
   echo "--- $name ---"
   local t0=$(date +%s)
@@ -114,7 +123,7 @@ run_local() {
       --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
       --sync-every "$SYNC_EVERY" --layer-loop "$LAYER_LOOP" \
       --results-dir "$RESULTS_DIR/${name}_results" \
-      $EXTRA_ARGS \
+      $extra \
       > "$log" 2>&1; then
     scripts/collect_results.sh --log "$log" "$RESULTS_DIR/${name}_results" \
       || true
@@ -128,17 +137,19 @@ run_local() {
 }
 
 run_k8s() {
-  local strategy="$1" ws="$2"
+  local strategy="$1" ws="$2" comp="${3-}" suffix="${4-}"
   # Unique job name per run: the collector scrapes into
   # $RESULTS_DIR/<job>_results, so a shared name would make each of the
   # matrix runs overwrite the previous one's result.json (pod filesystems
   # are ephemeral — the scrape is the only copy).
   local job="tpu-bench-${strategy}-ws${ws}"
+  [ -n "$suffix" ] && job="${job}-${suffix}"
   echo "--- $job (k8s) ---"
   scripts/launch_multi.sh --strategy "$strategy" --world-size "$ws" \
     --seq-len "$SEQ_LEN" --tier "$TIER" --steps "$STEPS" \
     --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
     --attention "$ATTENTION" --layer-loop "$LAYER_LOOP" --job-name "$job" \
+    $comp \
     ${IMAGE:+--image "$IMAGE"}
   if kubectl -n "$NAMESPACE" wait --for=condition=complete \
        "job/$job" --timeout=900s; then
@@ -152,11 +163,48 @@ run_k8s() {
   kubectl -n "$NAMESPACE" delete job "$job" --ignore-not-found
 }
 
-for strategy in $STRATEGIES; do
-  for ws in $WORLD_SIZES; do
-    if [ "$MODE" = "local" ]; then run_local "$strategy" "$ws"; else run_k8s "$strategy" "$ws"; fi
+if [ "$COMPOSITIONS" != "only" ]; then
+  for strategy in $STRATEGIES; do
+    for ws in $WORLD_SIZES; do
+      if [ "$MODE" = "local" ]; then run_local "$strategy" "$ws"; else run_k8s "$strategy" "$ws"; fi
+    done
   done
-done
+fi
+
+# --- composition roster (see COMPOSITIONS above) ---
+WS_MAX=0
+for ws in $WORLD_SIZES; do [ "$ws" -gt "$WS_MAX" ] && WS_MAX=$ws; done
+if [ "$COMPOSITIONS" != "off" ] && [ "$WS_MAX" -ge 4 ]; then
+  # Interleaved needs n_layer % (pp * V) == 0: tier S has 2 layers -> V=1.
+  VIRT=2; [ "$TIER" = "S" ] && VIRT=1
+  # name|strategy|local harness flags|k8s launcher flags
+  ROSTER="
+tp2|ddp|--tensor-parallel 2|--tensor-parallel 2
+pp2-gpipe|ddp|--pipeline-parallel 2 --pipeline-schedule gpipe|--pipeline-parallel 2 --pipeline-schedule gpipe
+pp2-1f1b|ddp|--pipeline-parallel 2 --pipeline-schedule 1f1b|--pipeline-parallel 2 --pipeline-schedule 1f1b
+pp2-interleaved|ddp|--pipeline-parallel 2 --pipeline-schedule interleaved --virtual-stages $VIRT|--pipeline-parallel 2 --pipeline-schedule interleaved --virtual-stages $VIRT
+sp2-ring|zero2|--sequence-parallel 2 --attention ring|--sequence-parallel 2 --attention ring
+sp2-ulysses|zero2|--sequence-parallel 2 --attention ulysses|--sequence-parallel 2 --attention ulysses
+moe-ep2|zero2|--num-experts 4 --expert-parallel 2|--num-experts 4 --expert-parallel 2
+"
+  echo ""
+  echo "=== Composition arms (ws=$WS_MAX) ==="
+  while IFS='|' read -r cname cstrat cflags kflags; do
+    [ -z "$cname" ] && continue
+    if [ "$MODE" = "local" ]; then
+      # Keep the operator's EXTRA_ARGS (e.g. --param-dtype bf16) on the
+      # composition arms too — dropping them would silently measure the
+      # roster under a different config than the pure matrix; the suffix
+      # carries both slugs so run names stay collision-free.
+      run_local "$cstrat" "$WS_MAX" "$cflags $EXTRA_ARGS" \
+        "$cname${RUN_SUFFIX:+-$RUN_SUFFIX}"
+    else
+      run_k8s "$cstrat" "$WS_MAX" "$kflags" "$cname"
+    fi
+  done <<EOF
+$ROSTER
+EOF
+fi
 
 echo ""
 echo "=== Analysis ==="

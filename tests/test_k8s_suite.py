@@ -60,17 +60,27 @@ if "get" in argv and "pod" in argv:
 
 if "logs" in argv:
     pod = argv[-1]
-    m = re.match(r"tpu-bench-(\w+)-ws(\d+)", pod)
+    m = re.match(r"tpu-bench-(\w+)-ws(\d+)(?:-([\w-]+?))?-0$", pod)
     if m is None:
         # e.g. the failure-diagnostic call `kubectl logs -l job-name=... --tail=100`
         sys.exit(0)
-    strategy, ws = m.group(1), int(m.group(2))
+    strategy, ws, comp = m.group(1), int(m.group(2)), m.group(3) or ""
     result = {
         "strategy": strategy, "world_size": ws, "rank": 0, "seq_len": 128,
         "tier": "S", "steps": 6, "per_device_batch": 1, "grad_accum": 1,
         "tokens_per_sec": 1000.0 * ws, "mean_step_time_sec": 0.128,
         "mean_loss": 6.0, "peak_vram_gb": 1.0, "h2d_gbps_per_gpu": 1e-5,
     }
+    # Composition jobs carry their axes in result.json (the harness writes
+    # them; the analyzer keys run identity on them).
+    if comp == "tp2":
+        result["tensor_parallel"] = 2
+    elif comp.startswith("pp2-"):
+        result.update(pipeline_parallel=2, pipeline_schedule=comp[4:])
+    elif comp.startswith("sp2-"):
+        result.update(sequence_parallel=2, attention_impl=comp[4:])
+    elif comp == "moe-ep2":
+        result.update(expert_parallel=2, n_experts=4)
     print("boot log line")
     print("BENCHMARK_RESULT_JSON_START")
     print(json.dumps(result, indent=2))
@@ -103,6 +113,9 @@ def suite_run(tmp_path_factory):
     env["TIER"] = "S"
     env["SEQ_LEN"] = "128"
     env["STEPS"] = "6"
+    # These tests pin the PURE-matrix contract (4 jobs, exact names); the
+    # auto-appended composition roster has its own fixture below.
+    env["COMPOSITIONS"] = "off"
     proc = subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "run_all_benchmarks.sh"), "--k8s"],
         capture_output=True, text=True, env=env, timeout=300,
@@ -172,3 +185,88 @@ def test_metrics_csv_has_one_row_per_run(suite_run):
     assert set(zip(df.strategy, df.world_size)) == {
         ("ddp", 2), ("ddp", 4), ("zero2", 2), ("zero2", 4),
     }
+
+
+COMP_JOBS = {
+    "tpu-bench-ddp-ws4-tp2",
+    "tpu-bench-ddp-ws4-pp2-gpipe",
+    "tpu-bench-ddp-ws4-pp2-1f1b",
+    "tpu-bench-ddp-ws4-pp2-interleaved",
+    "tpu-bench-zero2-ws4-sp2-ring",
+    "tpu-bench-zero2-ws4-sp2-ulysses",
+    "tpu-bench-zero2-ws4-moe-ep2",
+}
+
+
+@pytest.fixture(scope="module")
+def roster_run(tmp_path_factory):
+    """k8s suite with COMPOSITIONS=only: the auto-appended extended-axis
+    roster (reference parity: its suite hard-codes the complete matrix;
+    ours extends it with tp/pp/sp/ep arms at the widest world size)."""
+    tmp = tmp_path_factory.mktemp("k8s_roster")
+    bindir = tmp / "bin"
+    bindir.mkdir()
+    kubectl = bindir / "kubectl"
+    kubectl.write_text(FAKE_KUBECTL)
+    kubectl.chmod(kubectl.stat().st_mode | stat.S_IEXEC)
+    results = tmp / "results"
+    env = dict(os.environ)
+    env["PATH"] = f"{bindir}:{env['PATH']}"
+    env["FAKE_KUBECTL_DIR"] = str(tmp)
+    env["RESULTS_DIR"] = str(results)
+    env["STRATEGIES"] = "ddp zero2"
+    env["WORLD_SIZES"] = "4"
+    env["TIER"] = "S"
+    env["SEQ_LEN"] = "128"
+    env["STEPS"] = "6"
+    env["COMPOSITIONS"] = "only"
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "run_all_benchmarks.sh"), "--k8s"],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    return proc, tmp, results
+
+
+def test_roster_exits_zero_with_seven_arms(roster_run):
+    proc, _, _ = roster_run
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
+    assert "7 passed, 0 failed" in proc.stdout
+
+
+def test_roster_job_names_and_manifest_env(roster_run):
+    _, tmp, _ = roster_run
+    manifests = {
+        f[len("manifest_"):-len(".yaml")]
+        for f in os.listdir(tmp) if f.startswith("manifest_")
+    }
+    assert manifests == COMP_JOBS, manifests
+    # Extended-axis env vars reach the pod spec substituted, so
+    # docker/entrypoint.sh turns them into harness flags.
+    tp = (tmp / "manifest_tpu-bench-ddp-ws4-tp2.yaml").read_text()
+    assert 'name: TENSOR_PARALLEL\n              value: "2"' in tp
+    il = (tmp / "manifest_tpu-bench-ddp-ws4-pp2-interleaved.yaml").read_text()
+    assert 'name: PIPELINE_PARALLEL\n              value: "2"' in il
+    assert 'name: PIPELINE_SCHEDULE\n              value: "interleaved"' in il
+    assert 'name: VIRTUAL_STAGES\n              value: "1"' in il  # tier S
+    ring = (tmp / "manifest_tpu-bench-zero2-ws4-sp2-ring.yaml").read_text()
+    assert 'name: SEQUENCE_PARALLEL\n              value: "2"' in ring
+    assert 'name: ATTENTION\n              value: "ring"' in ring
+    moe = (tmp / "manifest_tpu-bench-zero2-ws4-moe-ep2.yaml").read_text()
+    assert 'name: NUM_EXPERTS\n              value: "4"' in moe
+    assert 'name: EXPERT_PARALLEL\n              value: "2"' in moe
+    for f in manifests:
+        live = "\n".join(
+            l for l in (tmp / f"manifest_{f}.yaml").read_text().splitlines()
+            if not l.lstrip().startswith("#")
+        )
+        assert "{{" not in live, f
+
+
+def test_roster_rows_survive_dedup(roster_run):
+    _, _, results = roster_run
+    import pandas as pd
+
+    df = pd.read_csv(results / "summary" / "metrics.csv")
+    # 7 composition runs, all (strategy, ws)-colliding pairs kept distinct
+    # by the composition axes in the identity key.
+    assert len(df) == 7, df
