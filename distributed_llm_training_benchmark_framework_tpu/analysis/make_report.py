@@ -42,6 +42,46 @@ TRADEOFFS = {
 }
 
 
+def _fmt_params(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return ""
+    if n != n or n <= 0:  # NaN or absent
+        return ""
+    return f"{n/1e9:.2f}B" if n >= 1e9 else f"{n/1e6:.0f}M"
+
+
+def _composition_label(r) -> str:
+    """Slug of the non-default composition axes of one run row, so roster
+    arms sharing (strategy, world_size) stay distinguishable in the tables
+    (e.g. 'tp2', 'pp2-interleaved-v2', 'sp2', 'ep2x4e'); '-' for a pure
+    data-parallel row."""
+
+    def val(key, default=0):
+        v = r.get(key, default)
+        try:
+            f = float(v)
+        except (TypeError, ValueError):
+            return default
+        return default if f != f else int(f)  # NaN -> default
+
+    bits = []
+    if val("tensor_parallel", 1) > 1:
+        bits.append(f"tp{val('tensor_parallel', 1)}")
+    if val("sequence_parallel", 1) > 1:
+        bits.append(f"sp{val('sequence_parallel', 1)}")
+    if val("pipeline_parallel", 1) > 1:
+        sched = r.get("pipeline_schedule") or "gpipe"
+        pp = f"pp{val('pipeline_parallel', 1)}-{sched}"
+        if sched == "interleaved" and val("virtual_stages", 0) > 0:
+            pp += f"-v{val('virtual_stages', 0)}"
+        bits.append(pp)
+    if val("n_experts", 0) > 0:
+        bits.append(f"ep{max(val('expert_parallel', 1), 1)}x{val('n_experts', 0)}e")
+    return "+".join(bits) if bits else "-"
+
+
 def fmt_table(df: pd.DataFrame, cols: List[str]) -> str:
     header = "| " + " | ".join(cols) + " |"
     sep = "|" + "|".join(["---"] * len(cols)) + "|"
@@ -58,14 +98,28 @@ def fmt_table(df: pd.DataFrame, cols: List[str]) -> str:
 def build_report(
     df: pd.DataFrame, plots_dir: str = "../plots", plots_root: str = ""
 ) -> str:
+    df = df.copy()
     cols = [
         "strategy", "world_size", "seq_len", "tokens_per_sec",
         "mean_step_time_sec", "peak_vram_gb", "scaling_efficiency_pct",
     ]
+    # Tier + parameter count: without these the tier-B row is
+    # indistinguishable from a catastrophically slow tier-A row.
+    if "tier" in df.columns:
+        cols.insert(1, "tier")
+        if "n_params" in df.columns:
+            df["params"] = df["n_params"].map(_fmt_params)
+            cols.insert(2, "params")
+    # Composition axes: roster arms share (strategy, world_size) with the
+    # pure arms; a config slug keeps every row identifiable.
+    comp = df.apply(_composition_label, axis=1)
+    if (comp != "-").any():
+        df["config"] = comp
+        cols.insert(1, "config")
     # TPU-additive columns, surfaced when the data carries them: attention
     # impl (reference vs flash rows share a table) and MFU.
     if "attention_impl" in df.columns and df["attention_impl"].nunique() > 1:
-        cols.insert(3, "attention_impl")
+        cols.insert(cols.index("tokens_per_sec"), "attention_impl")
     if "mfu_pct" in df.columns and (df["mfu_pct"] > 0).any():
         cols.insert(cols.index("mean_step_time_sec") + 1, "mfu_pct")
     if "est_hbm_gb" in df.columns and (

@@ -79,10 +79,11 @@ class TinyGPTConfig:
     flash_block_q: Optional[int] = None
     flash_block_k: Optional[int] = None
     flash_block_k_bwd: Optional[int] = None
-    # Hand-written Pallas backward kernels instead of the XLA-fused blockwise
-    # einsum backward (ops/flash_attention defaults to the latter; see its
-    # docstring for the v5e measurements behind the default).
-    flash_pallas_backward: bool = False
+    # Flash backward implementation: None = auto (the measured S-dependent
+    # crossover in ops/flash_attention — einsum backward to S=2048, Pallas
+    # kernels from S=4096); True forces the Pallas kernels, False forces the
+    # XLA-fused blockwise einsum backward.
+    flash_pallas_backward: Optional[bool] = None
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
     # Per-layer rematerialization policy inside the scan:

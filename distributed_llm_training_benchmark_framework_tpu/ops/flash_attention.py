@@ -17,10 +17,12 @@ Forward (Pallas kernel):
 - ``causal=True`` masks by global position and skips fully-masked k blocks.
 
 Backward (custom VJP): recomputes attention probabilities blockwise over K
-from the saved logsumexp — the standard flash backward — as a ``lax.scan`` of
-dense jnp blocks, so peak memory is O(S * block) instead of O(S^2) and XLA
-fuses it onto the MXU on TPU. (A hand-written Pallas backward kernel is a
-further optimization, not a semantic change.)
+from the saved logsumexp — the standard flash backward — with two
+implementations sharing the same math: an XLA-fused ``lax.scan`` of dense
+jnp blocks (peak memory O(S * block)), and hand-written Pallas dq / dk+dv
+kernels. Which is faster is S-dependent on v5e (einsum to S=2048, kernels
+from S=4096 with margins growing to +88% at 16K — docs/PERFORMANCE.md §12);
+``pallas_backward=None`` auto-selects by the measured crossover.
 
 On non-TPU backends the forward kernel runs in Pallas interpret mode (slow but
 bit-honest), keeping the CPU test/smoke paths real.
@@ -112,6 +114,14 @@ def _pick_block(seq_len: int, preferred: int = 512) -> int:
 _FWD_BLOCK_Q = 1024
 _FWD_BLOCK_K = 1024
 _BWD_BLOCK_K = 512
+
+# Backward implementation crossover, measured on v5e tier A (docs/
+# PERFORMANCE.md §12): the XLA-fused blockwise-einsum backward wins at
+# S=2048 (41.6k vs 38.4k tok/s) but the Pallas backward kernels win from
+# S=4096 up, by growing margins (+14% @4K, +45% @8K, +88% @16K) — the
+# einsum path's (BH, S, bk) probability tiles become HBM-bandwidth-bound
+# while the kernels keep them in VMEM. pallas_backward=None picks by S.
+_PALLAS_BWD_MIN_SEQ = 4096
 
 
 def _flash_fwd_kernel(
@@ -617,7 +627,7 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     block_k_bwd: Optional[int] = None,
-    pallas_backward: bool = False,
+    pallas_backward: Optional[bool] = None,
     dropout_rate: float = 0.0,
     dropout_seed: Optional[jax.Array] = None,
 ) -> jax.Array:
@@ -637,6 +647,11 @@ def flash_attention(
     B, S, H, D = q.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if pallas_backward is None:
+        # Auto: the measured S-dependent crossover (_PALLAS_BWD_MIN_SEQ).
+        # Interpret mode keeps the einsum backward — the Pallas bwd kernels
+        # would run under the slow HLO interpreter for no fidelity gain.
+        pallas_backward = (not interpret) and S >= _PALLAS_BWD_MIN_SEQ
     bq = block_q or _pick_block(S, _FWD_BLOCK_Q)
     bk = block_k or _pick_block(S, _FWD_BLOCK_K)
     bk_bwd = block_k_bwd or _pick_block(S, _BWD_BLOCK_K)

@@ -488,6 +488,15 @@ def ring_attention_sharded(
     bq = block_q or _pick_block(Sl, _FWD_BLOCK_Q)
     bk = block_k or _pick_block(Sl, _FWD_BLOCK_K)
     bk_bwd = block_k_bwd or _pick_block(Sl, _BWD_BLOCK_K)
+    if Sl % bq != 0 or Sl % bk != 0 or Sl % bk_bwd != 0:
+        # Same contract as flash_attention, against the LOCAL shard: a
+        # non-dividing (or oversized) block would silently truncate the
+        # kernel grid (Sl // bq floor) and compute wrong attention.
+        raise ValueError(
+            f"block sizes (block_q={bq}, block_k={bk}, block_k_bwd="
+            f"{bk_bwd}) must divide the local sequence shard "
+            f"S/sp={Sl} (global S sharded over '{axis_name}')"
+        )
     opts = (
         axis_name, causal, dropout_rate, batch_axis, heads_axis,
         interpret, bq, bk, bk_bwd,

@@ -92,7 +92,7 @@ def ulysses_attention_sharded(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     block_k_bwd: Optional[int] = None,
-    pallas_backward: bool = False,
+    pallas_backward: Optional[bool] = None,
 ) -> jax.Array:
     """Ulysses body; call inside shard_map with seq sharded on axis_name.
 
@@ -146,7 +146,7 @@ def ulysses_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     block_k_bwd: Optional[int] = None,
-    pallas_backward: bool = False,
+    pallas_backward: Optional[bool] = None,
 ) -> jax.Array:
     """Shard the sequence over ``axis_name`` and run Ulysses. Falls back to
     plain flash when no such mesh axis is in scope (mirrors ring_attention's

@@ -44,9 +44,13 @@ TPU-native construction (nothing like Megatron's process-per-stage runtime):
   whole run — checkpoints record the layout and refuse a mismatched resume.
   Dropout keys use GLOBAL layer indices, so the math is layout-independent.
 
-Constraints: n_layer % (pipe * virtual) == 0; dense blocks only (MoE's aux
-cotangent is wired through gpipe/1f1b — compose MoE with those schedules).
-Sequence parallelism composes the same way as the other schedules (manual
+Constraints: n_layer % (pipe * virtual) == 0. MoE composes: each chunk's
+forward returns its layers' Switch load-balance aux alongside the
+activation, F units (and the head unit, whose chunk has no F) accumulate
+the primal aux, and every chunk backward seeds the constant aux cotangent
+coef/(n_layer*n_micro) — the same accounting gpipe/1f1b use, per chunk
+instead of per stage. Sequence parallelism composes the same way as the
+other schedules (manual
 over ('pipe','seq'), sharded ring/Ulysses attention, CE psum over 'seq') —
 with one backend-specific execution detail. With sp>1 the unit bodies
 contain 'seq'-axis collectives, and the per-tick ``lax.switch`` index varies
@@ -313,11 +317,6 @@ def interleaved_loss_and_grads(
             f"n_layer={config.n_layer} not divisible by pipe*virtual="
             f"{n_stages}*{V}"
         )
-    if config.n_experts > 0:
-        raise ValueError(
-            "MoE is not wired through the interleaved schedule; use "
-            "pipeline_schedule gpipe or 1f1b for MoE x pp"
-        )
     config, seq_ax, sp, manual_axes, batch_spec = _seq_setup(config, mesh)
     # See the module docstring: XLA:CPU's collective rendezvous spans all
     # local devices per instruction, so 'seq' collectives inside the
@@ -331,6 +330,7 @@ def interleaved_loss_and_grads(
     perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
     inv_m = 1.0 / n_micro
     var_axes = (AXIS,) + ((seq_ax,) if seq_ax else ())
+    moe = config.n_experts > 0
 
     def staged(params, batch):
         stage = lax.axis_index(AXIS)
@@ -360,6 +360,16 @@ def interleaved_loss_and_grads(
         bwd_msg = var(jnp.zeros((mb, S, D), cd))
         d_blocks = jax.tree.map(lambda x: var_p(jnp.zeros_like(x)), blocks)
         loss_sum = var_p(jnp.zeros((), jnp.float32))
+        # MoE: chunk forwards return their layers' Switch load-balance aux;
+        # F units (and the head unit, whose chunk never runs an F) add the
+        # primal aux, every chunk backward seeds the constant cotangent —
+        # the weight of the aux term in the final loss. Every scheduled
+        # unit is a real (microbatch, chunk), so no validity masking is
+        # needed (unlike the lockstep schedules' fill/drain ticks).
+        aux_sum = var_p(jnp.zeros((), jnp.float32))
+        aux_ct_const = (
+            config.router_aux_coef / (config.n_layer * n_micro) if moe else 0.0
+        )
 
         hp = {k: params[k] for k in ("lnf_scale", "lnf_bias", "wte")}
         ep = {k: params[k] for k in ("wte", "wpe")}
@@ -402,15 +412,28 @@ def interleaved_loss_and_grads(
                 jax.random.fold_in(base_key, m + j // V) if live_keys
                 else None
             )
-            y, _ = tinygpt.apply_blocks(
+            y, aux = tinygpt.apply_blocks(
                 config, blk_c, x, key, deterministic,
                 layer_offset=j * Lc,
             )
-            return y
+            if moe:
+                if seq_ax is not None:
+                    # Shard-local aux averaged over sequence shards
+                    # (seq-invariant, matching pipeline.stage_fwd).
+                    aux = lax.psum(aux, seq_ax) / sp
+            else:
+                # Dense: apply_blocks' zero aux carries the activation's
+                # full (seq,pipe) vma, which would widen the aux carry and
+                # the final loss; a fresh zero stays pipe-varying only.
+                # Its vjp cotangent (constant 0.0) reaches nothing.
+                aux = jnp.zeros((), jnp.float32)
+            # Always (y, aux): the uniform shape keeps the dense and MoE
+            # vjp/seeding code identical (one copy, not four).
+            return y, var_p(aux)
 
         def tick(carry, row):
             (pend_f, pend_b, resid, fwd_msg, bwd_msg,
-             d_blocks, d_hp, d_ep, loss_sum) = carry
+             d_blocks, d_hp, d_ep, loss_sum, aux_sum) = carry
             t = dict(zip(_TABLES, [r[stage] for r in row]))
 
             # Park arrivals (messages sent on the rings last tick).
@@ -452,8 +475,8 @@ def interleaved_loss_and_grads(
                 resid2 = lax.dynamic_update_index_in_dim(
                     resid, x_in, jnp.maximum(t["resid_rw"], 0), 0
                 )
-                y = chunk_fwd(blk_c, x_in, m_s, v_s)
-                return (resid2, y, zero_out, zb, zh, ze, zl)
+                y, aux_t = chunk_fwd(blk_c, x_in, m_s, v_s)
+                return (resid2, y, zero_out, zb, zh, ze, zl, aux_t)
 
             def b_unit():
                 is_head = t["b_head"] == 1
@@ -472,31 +495,42 @@ def interleaved_loss_and_grads(
                 )
 
                 def head_vjp():
+                    # The head position (PV-1) never runs an F unit, so its
+                    # chunk's primal aux is accumulated HERE, alongside the
+                    # loss; every other chunk's aux came from its F unit.
                     def fn(blk_a, hp_a, x):
-                        y = chunk_fwd(blk_a, x, m_s, v_s)
-                        return tinygpt._cross_entropy(
+                        y, aux = chunk_fwd(blk_a, x, m_s, v_s)
+                        l = tinygpt._cross_entropy(
                             tinygpt.head(config, hp_a, y), tgt, seq_axis=seq_ax
                         )
-                    l, vjp = jax.vjp(fn, blk_c, hp_in, x_saved)
+                        return l, aux
+                    (l, aux_p), vjp = jax.vjp(fn, blk_c, hp_in, x_saved)
                     dl = var_p(jnp.asarray(inv_m, jnp.float32))
-                    d_blk, d_hp_t, d_x = vjp(dl)
-                    return l, d_blk, d_hp_t, d_x
+                    d_blk, d_hp_t, d_x = vjp(
+                        (dl, jnp.zeros_like(aux_p) + aux_ct_const)
+                    )
+                    return l, d_blk, d_hp_t, d_x, aux_p
 
                 def plain_vjp():
-                    _, vjp = jax.vjp(
+                    # Chunk backward: seed the constant aux cotangent (its
+                    # weight in the final loss — 0.0 for dense); the primal
+                    # aux was already counted by this unit's F.
+                    (_, aux_p), vjp = jax.vjp(
                         lambda blk_a, x: chunk_fwd(blk_a, x, m_s, v_s),
                         blk_c, x_saved,
                     )
-                    d_blk, d_x = vjp(g_parked)
-                    return zl, d_blk, zh, d_x
+                    d_blk, d_x = vjp(
+                        (g_parked, jnp.zeros_like(aux_p) + aux_ct_const)
+                    )
+                    return zl, d_blk, zh, d_x, zl
 
                 if uniform_units:
-                    l, d_blk, d_hp_t, d_x = jax.tree.map(
+                    l, d_blk, d_hp_t, d_x, aux_p = jax.tree.map(
                         lambda h, p: jnp.where(is_head, h, p),
                         head_vjp(), plain_vjp(),
                     )
                 else:
-                    l, d_blk, d_hp_t, d_x = lax.cond(
+                    l, d_blk, d_hp_t, d_x, aux_p = lax.cond(
                         is_head, head_vjp, plain_vjp
                     )
 
@@ -513,29 +547,29 @@ def interleaved_loss_and_grads(
                 (d_ep_t,) = vjp_emb(
                     jnp.where(is_embed, d_x, jnp.zeros((), d_x.dtype))
                 )
-                return (resid, zero_out, d_x, d_blk, d_hp_t, d_ep_t, l)
+                return (resid, zero_out, d_x, d_blk, d_hp_t, d_ep_t, l,
+                        aux_p)
 
             def idle_unit():
-                return (resid, zero_out, zero_out, zb, zh, ze, zl)
+                return (resid, zero_out, zero_out, zb, zh, ze, zl, zl)
 
             if uniform_units:
                 k = t["kind"]
-                (resid, f_out, b_out, d_blk_t, d_hp_t, d_ep_t, l_t) = (
-                    jax.tree.map(
-                        lambda i, f, b: jnp.where(
-                            k == FWD, f, jnp.where(k == BWD, b, i)
-                        ),
-                        idle_unit(), f_unit(), b_unit(),
-                    )
+                (resid, f_out, b_out, d_blk_t, d_hp_t, d_ep_t, l_t,
+                 aux_t) = jax.tree.map(
+                    lambda i, f, b: jnp.where(
+                        k == FWD, f, jnp.where(k == BWD, b, i)
+                    ),
+                    idle_unit(), f_unit(), b_unit(),
                 )
             else:
-                (resid, f_out, b_out, d_blk_t, d_hp_t, d_ep_t, l_t) = (
-                    lax.switch(t["kind"], [idle_unit, f_unit, b_unit])
-                )
+                (resid, f_out, b_out, d_blk_t, d_hp_t, d_ep_t, l_t,
+                 aux_t) = lax.switch(t["kind"], [idle_unit, f_unit, b_unit])
             d_blocks = chunk_update_add(d_blocks, d_blk_t, v_s)
             d_hp = jax.tree.map(jnp.add, d_hp, d_hp_t)
             d_ep = jax.tree.map(jnp.add, d_ep, d_ep_t)
             loss_sum = loss_sum + l_t
+            aux_sum = aux_sum + aux_t
 
             fwd_msg = lax.ppermute(
                 jnp.where(t["send_f"] == 1, f_out, jnp.zeros((), cd)),
@@ -546,15 +580,22 @@ def interleaved_loss_and_grads(
                 AXIS, perm_bwd,
             )
             return (pend_f, pend_b, resid, fwd_msg, bwd_msg,
-                    d_blocks, d_hp, d_ep, loss_sum), None
+                    d_blocks, d_hp, d_ep, loss_sum, aux_sum), None
 
         carry = (pend_f, pend_b, resid, fwd_msg, bwd_msg,
-                 d_blocks, d_hp, d_ep, loss_sum)
+                 d_blocks, d_hp, d_ep, loss_sum, aux_sum)
         xs = tuple(jnp.asarray(getattr(sched, n)) for n in _TABLES)
         carry, _ = lax.scan(tick, carry, xs)
 
-        (_, _, _, _, _, d_blocks, d_hp, d_ep, loss_sum) = carry
+        (_, _, _, _, _, d_blocks, d_hp, d_ep, loss_sum, aux_sum) = carry
         loss = lax.psum(loss_sum, AXIS) * inv_m
+        if moe:
+            # Every (microbatch, chunk) contributed its layers' aux exactly
+            # once; normalize as gpipe/1f1b do: coef * mean per layer per
+            # microbatch.
+            loss = loss + config.router_aux_coef * lax.psum(
+                aux_sum, AXIS
+            ) / (config.n_layer * n_micro)
         d_hp = jax.tree.map(lambda x: lax.psum(x, var_axes), d_hp)
         d_ep = jax.tree.map(lambda x: lax.psum(x, var_axes), d_ep)
         grads = {

@@ -106,8 +106,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "weights (fast compile) or an unrolled loop (~15%% "
                         "faster single-chip step; slower compile)")
     p.add_argument("--flash-pallas-backward", action="store_true",
-                   help="Use the hand-written Pallas backward kernels instead "
-                        "of the XLA-fused blockwise einsum backward")
+                   help="Force the hand-written Pallas backward kernels. "
+                        "Default is auto: the measured S-dependent crossover "
+                        "(einsum backward to seq 2048, Pallas kernels from "
+                        "4096 — docs/PERFORMANCE.md)")
+    p.add_argument("--flash-blockwise-backward", action="store_true",
+                   help="Force the XLA-fused blockwise einsum backward "
+                        "(overrides the auto S-dependent selection)")
     p.add_argument("--flash-block-k-bwd", type=int, default=None,
                    help="Flash-attention backward k tile size (the fwd/bwd "
                         "optima differ; default: kernel-tuned)")
@@ -184,6 +189,11 @@ def main(argv=None) -> int:
 
     honor_jax_platforms_env()
     args = build_parser().parse_args(argv)
+    if args.flash_pallas_backward and args.flash_blockwise_backward:
+        raise SystemExit(
+            "--flash-pallas-backward and --flash-blockwise-backward are "
+            "mutually exclusive (omit both for the auto S-dependent choice)"
+        )
     # Reference parity: ZeRO arms demand a config path (train_harness.py:501-502).
     if args.strategy in ("zero2", "zero3") and not (
         args.strategy_config or args.deepspeed_config or args.fsdp_config
@@ -241,7 +251,11 @@ def main(argv=None) -> int:
             flash_block_q=args.flash_block_q,
             flash_block_k=args.flash_block_k,
             flash_block_k_bwd=args.flash_block_k_bwd,
-            flash_pallas_backward=args.flash_pallas_backward,
+            flash_pallas_backward=(
+                True if args.flash_pallas_backward
+                else False if args.flash_blockwise_backward
+                else None
+            ),
             layer_loop=args.layer_loop,
             prng_impl=args.prng_impl,
             dataset_size=args.dataset_size,

@@ -80,7 +80,7 @@ def _run_benchmark_impl(
     flash_block_q: Optional[int] = None,
     flash_block_k: Optional[int] = None,
     flash_block_k_bwd: Optional[int] = None,
-    flash_pallas_backward: bool = False,
+    flash_pallas_backward: Optional[bool] = None,
     layer_loop: str = "scan",
     dataset_size: int = 1000,
     log_every: int = 10,
@@ -149,8 +149,8 @@ def _run_benchmark_impl(
         overrides["flash_block_k"] = flash_block_k
     if flash_block_k_bwd is not None:
         overrides["flash_block_k_bwd"] = flash_block_k_bwd
-    if flash_pallas_backward:
-        overrides["flash_pallas_backward"] = True
+    if flash_pallas_backward is not None:
+        overrides["flash_pallas_backward"] = flash_pallas_backward
     if layer_loop == "unrolled":
         # Unrolled layer loop: ~15% faster single-chip (activations save as
         # distinct buffers, no dynamic-update-slice stacking) at the cost of
@@ -178,10 +178,31 @@ def _run_benchmark_impl(
     from .step import _resolve_model_config
 
     if strategy.remat == "auto":
+        import dataclasses as _dc
+
+        from .step import abstract_step_peak_bytes
+
+        def _aot_probe(pol: str):
+            # Measured near-capacity decision: compile the REAL step for
+            # this policy abstractly (no allocation) and return XLA's
+            # buffer-assignment peak. ~one compile of startup cost, paid
+            # only when the analytic margin is inconclusive.
+            if is_main:
+                print(f"Auto remat: probing '{pol}' via abstract AOT compile...")
+            return abstract_step_peak_bytes(
+                model_config, _dc.replace(strategy, remat=pol), mesh,
+                grad_accum=grad_accum, seed=seed, from_table=True,
+                global_micro=global_micro, seq_len=seq_len,
+                dataset_size=dataset_size,
+                pipeline_schedule=pipeline_schedule,
+                virtual_stages=virtual_stages,
+            )
+
         strategy = memory_mod.resolve_auto_remat(
             _resolve_model_config(model_config, strategy, mesh), strategy, mesh,
             per_device_batch, seq_len, dataset_size=dataset_size,
             device_kind=devices[0].device_kind,
+            aot_probe=_aot_probe,
         )
         if is_main:
             print(f"Auto remat: resolved to '{strategy.remat}' for this arm")

@@ -271,6 +271,90 @@ def make_train_step(
     return step_with_mesh, aot_compile
 
 
+def abstract_step_peak_bytes(
+    model_config: tinygpt.TinyGPTConfig,
+    strategy: strat.StrategyConfig,
+    mesh: Mesh,
+    grad_accum: int = 1,
+    seed: int = 0,
+    from_table: bool = True,
+    global_micro: int = 1,
+    seq_len: int = 0,
+    dataset_size: int = 64,
+    pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 2,
+) -> Optional[int]:
+    """XLA's buffer-assignment peak for the train step, WITHOUT allocating.
+
+    Lowers and compiles the exact train-step executable from
+    ``ShapeDtypeStruct``s (no params are initialized, no device memory is
+    touched) and reads ``memory_analysis().peak_memory_in_bytes`` — the
+    measured compiled-program requirement, as opposed to the analytic
+    ``utils.memory.estimate_hbm`` model. Returns None when the program
+    cannot compile at all (e.g. the compiler itself reports HBM OOM) or the
+    runtime exposes no memory analysis. Used by ``resolve_auto_remat``'s
+    probe path to decide near-capacity remat policies by measurement; costs
+    one XLA compile (the result is NOT reused by the later real step, whose
+    jit cache keys on a different closure).
+    """
+    cfg = _resolve_model_config(model_config, strategy, mesh)
+    optimizer = strat.make_optimizer(strategy)
+    params_shape = jax.eval_shape(
+        lambda key: tinygpt.init_params(cfg, key), jax.random.key(0)
+    )
+    param_specs = strat.param_partition_specs(
+        params_shape, mesh, shard=strategy.shard_params
+    )
+    opt_specs = strat.opt_state_partition_specs(
+        optimizer, params_shape, param_specs, mesh, shard=strategy.shard_opt_state
+    )
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+
+    step_fn, aot_compile = make_train_step(
+        model_config, strategy, optimizer, mesh, param_specs, opt_specs,
+        grad_accum=grad_accum, seed=seed, from_table=from_table,
+        global_micro=global_micro, seq_len=seq_len,
+        pipeline_schedule=pipeline_schedule, virtual_stages=virtual_stages,
+    )
+
+    def abstract(tree, specs):
+        return jax.tree.map(
+            lambda s, spec: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=NamedSharding(mesh, spec)
+            ),
+            tree, specs,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+        )
+
+    params_abs = abstract(params_shape, param_specs)
+    opt_abs = abstract(opt_shape, opt_specs)
+    if from_table:
+        batch_abs = jax.ShapeDtypeStruct(
+            (dataset_size, seq_len), jnp.int32,
+            sharding=NamedSharding(mesh, P()),
+        )
+    else:
+        batch_abs = jax.ShapeDtypeStruct(
+            (grad_accum, global_micro, seq_len), jnp.int32,
+            sharding=NamedSharding(mesh, P(None, *strat.batch_partition_spec(mesh))),
+        )
+    try:
+        compiled = aot_compile(params_abs, opt_abs, batch_abs, 0)
+        peak = int(getattr(compiled.memory_analysis(), "peak_memory_in_bytes", 0))
+        return peak if peak > 0 else None
+    except Exception as e:
+        # A compiler HBM-OOM here legitimately means "this policy does not
+        # fit" — but a swallowed programming error would silently disable
+        # the probe and quietly revert every near-capacity arm to the
+        # conservative remat chain, so always say WHY the probe failed.
+        msg = str(e)
+        print(
+            f"AOT probe: compile failed ({type(e).__name__}: "
+            f"{msg[:300]}{'...' if len(msg) > 300 else ''})"
+        )
+        return None
+
+
 def create_train_state(
     model_config: tinygpt.TinyGPTConfig,
     strategy: strat.StrategyConfig,

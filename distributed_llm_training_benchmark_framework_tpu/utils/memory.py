@@ -211,6 +211,13 @@ def format_breakdown(est: HBMEstimate, device_kind: str) -> str:
 # estimate must stay below this fraction of HBM before a cheaper policy is
 # chosen. Derived from the measured est->actual bias (docs/PERFORMANCE.md).
 AUTO_REMAT_MARGIN = 0.70
+# When the analytic margin rejects a policy but the estimate still fits
+# nominal capacity, the resolver can ask XLA directly (an abstract AOT
+# compile of the real step — train.step.abstract_step_peak_bytes) and
+# accept on the MEASURED buffer-assignment peak. 0.96 of nominal keeps
+# ~4% runtime headroom below XLA's own usable limit (~98.4% of nominal on
+# v5e: "15.75G of 16G" in compiler OOM reports).
+AOT_PROBE_ACCEPT_MARGIN = 0.96
 
 
 def check_fits(
@@ -246,6 +253,7 @@ def resolve_auto_remat(
     seq_len: int,
     dataset_size: int = 0,
     device_kind: str = "",
+    aot_probe: Optional[Any] = None,
 ) -> Any:
     """Resolve a strategy's remat="auto" to the cheapest policy that fits.
 
@@ -257,20 +265,27 @@ def resolve_auto_remat(
     strategy unchanged unless remat == "auto". Unknown device kinds (CPU)
     are never refused by check_fits, so they resolve to "none".
 
-    The policy choice uses a STRICTER margin than the go/no-go pre-flight
-    (AUTO_REMAT_MARGIN vs check_fits' 0.95): measured peaks run 13-50% above
-    the analytic estimate (XLA temp buffers the model ignores — see the
-    est-vs-measured table in docs/PERFORMANCE.md), and a policy that
-    nominally fits at 92% of HBM thrashes the allocator in practice
-    (zero3 @ 16K seq: est 14.7/16 GiB under "none" ran with 10 s -> 87 s
-    oscillating step times until the suite timeout). Picking the next
-    policy up costs only its recompute tax; picking one level too low
-    costs the whole run.
+    The analytic policy choice uses a STRICTER margin than the go/no-go
+    pre-flight (AUTO_REMAT_MARGIN vs check_fits' 0.95): measured peaks run
+    up to ~13% above the analytic estimate (XLA temp buffers the model
+    ignores — see the est-vs-measured table in docs/PERFORMANCE.md), so a
+    nominal analytic fit near capacity cannot be trusted. But an analytic
+    REJECTION near capacity cannot be trusted either: at 16K the cheapest
+    policy that actually fits ("none", measured buffer-assignment peak
+    15.53e9 of 17.18e9 bytes) is 26% faster than "full", and the analytic
+    margin alone would forfeit that. So when ``aot_probe`` is provided
+    (a callable (remat_policy) -> Optional[peak_bytes] — the harness wires
+    train.step.abstract_step_peak_bytes), policies in the ambiguous band
+    (analytic margin rejects, estimate still <= nominal capacity) are
+    decided by an abstract AOT compile of the real step: accept iff XLA's
+    measured buffer-assignment peak fits AOT_PROBE_ACCEPT_MARGIN. Costs one
+    extra XLA compile per probed policy, only ever near capacity.
     """
     import dataclasses as _dc
 
     if getattr(strategy, "remat", None) != "auto":
         return strategy
+    cap = device_hbm_bytes(device_kind)
     for pol in ("none", "dots", "full"):
         cand = _dc.replace(strategy, remat=pol)
         cfg = _dc.replace(model_config, remat=pol)
@@ -279,6 +294,17 @@ def resolve_auto_remat(
         )
         if check_fits(est, device_kind, margin=AUTO_REMAT_MARGIN) is None:
             return cand
+        # Probe band capped at the downstream pre-flight's own margin
+        # (0.95): a probe-accepted policy must also pass check_fits in the
+        # benchmark loop, or the resolver would hand back an arm the
+        # pre-flight immediately refuses (where escalating would have run).
+        if (
+            aot_probe is not None and cap is not None
+            and check_fits(est, device_kind) is None
+        ):
+            peak = aot_probe(pol)
+            if peak is not None and peak <= cap * AOT_PROBE_ACCEPT_MARGIN:
+                return cand
     # Nothing fits; return the most memory-frugal policy and let the
     # pre-flight check downstream produce the refusal message.
     return _dc.replace(strategy, remat="full")

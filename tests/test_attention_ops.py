@@ -195,6 +195,35 @@ def test_flash_dropout_none_seed_is_deterministic():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
 
+def test_flash_backward_auto_selects_einsum_on_cpu(monkeypatch):
+    """pallas_backward=None (auto) must take the blockwise-einsum backward
+    in interpret mode regardless of S — the Pallas bwd kernels under the
+    HLO interpreter are pure slowdown. Forcing True takes the kernel path."""
+    from distributed_llm_training_benchmark_framework_tpu.ops import (
+        flash_attention as fa,
+    )
+
+    calls = []
+    real = fa._jnp_blockwise_bwd
+    monkeypatch.setattr(
+        fa, "_jnp_blockwise_bwd",
+        lambda *a, **k: calls.append("einsum") or real(*a, **k),
+    )
+    q, k, v = qkv(B=1, S=64, H=2, D=16)
+
+    def loss(q, pallas):
+        return fa.flash_attention(
+            q, k, v, interpret=True, pallas_backward=pallas,
+            block_q=32, block_k=32, block_k_bwd=32,
+        ).astype(jnp.float32).sum()
+
+    jax.grad(lambda q: loss(q, None))(q)
+    assert calls == ["einsum"]
+    calls.clear()
+    jax.grad(lambda q: loss(q, True))(q)  # forced: Pallas kernels (interpret)
+    assert calls == []
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_matches_reference(causal, eight_devices):
     mesh = make_mesh((4,), ("seq",), devices=eight_devices[:4])

@@ -171,6 +171,46 @@ def test_interleaved_trajectory_matches_gpipe(eight_devices):
     )
 
 
+@pytest.mark.slow
+def test_interleaved_moe_matches_gpipe(eight_devices):
+    """MoE x interleaved: loss (CE + Switch aux) and grads — router weights
+    included — match autodiff-GPipe through the layer permutation. The head
+    chunk's aux is counted by its backward-only unit; every chunk backward
+    seeds the constant aux cotangent."""
+    cfg = get_model_config(
+        "S", 64, dropout=0.0, n_layer=4, n_experts=4,
+        compute_dtype=jnp.float32,
+    )
+    params = init_params(cfg, jax.random.key(0))
+    mesh = make_mesh((1, 1, 1, 2), ("data", "seq", "model", "pipe"),
+                     devices=jax.devices()[:2])
+    ds = SyntheticDataset(vocab_size=512, seq_len=64, size=32)
+    M = 4
+    batch = ds.batch_for_step(0, M * 2).reshape(M, 2, 64)
+
+    perm = layer_permutation(4, 2, 2)
+    params_perm = dict(params)
+    params_perm["blocks"] = jax.tree.map(lambda x: x[perm], params["blocks"])
+
+    with jax.set_mesh(mesh):
+        g_loss, g_grads = jax.jit(
+            jax.value_and_grad(lambda p: pipeline_loss_fn(cfg, mesh, p, batch))
+        )(params)
+        i_loss, i_grads = jax.jit(
+            lambda p: interleaved_loss_and_grads(cfg, mesh, p, batch, virtual=2)
+        )(params_perm)
+
+    np.testing.assert_allclose(float(i_loss), float(g_loss), rtol=1e-5)
+    g_perm = dict(g_grads)
+    g_perm["blocks"] = jax.tree.map(lambda x: x[perm], g_grads["blocks"])
+    flat_i = dict(jax.tree_util.tree_leaves_with_path(i_grads))
+    for path, g in jax.tree_util.tree_leaves_with_path(g_perm):
+        np.testing.assert_allclose(
+            np.asarray(flat_i[path]), np.asarray(g), rtol=1e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 def test_interleaved_rejects_indivisible_layers():
     cfg = get_model_config("S", 64, dropout=0.0)  # 2 layers, pipe*virtual=4
     params = init_params(cfg, jax.random.key(0))

@@ -139,6 +139,78 @@ def test_resolve_auto_remat_under_pressure_escalates():
     assert out.remat in ("dots", "full")
 
 
+def test_resolve_auto_remat_aot_probe_band():
+    """The AOT probe decides policies the analytic margin rejects but whose
+    estimate still fits nominal capacity: a fitting measured peak accepts
+    the cheap policy, an over-margin peak (or probe failure) falls through
+    to the next one."""
+    from distributed_llm_training_benchmark_framework_tpu.utils.memory import (
+        AOT_PROBE_ACCEPT_MARGIN,
+        device_hbm_bytes,
+        resolve_auto_remat,
+    )
+
+    strat = get_strategy("zero3")
+    # seq 16384 @ batch 1: the real 16K operating point — analytic margin
+    # (0.70) rejects "none" (est ~14.7 GiB of 16) and "dots", yet both
+    # estimates are under nominal capacity, so both land in the probe band.
+    cfg = get_model_config("A", 16384, attention_impl="flash")
+    cap = device_hbm_bytes("TPU v5 lite")
+    probed = []
+
+    def probe_fits(pol):
+        probed.append(pol)
+        return int(cap * AOT_PROBE_ACCEPT_MARGIN) - 1
+
+    out = resolve_auto_remat(
+        cfg, strat, _mesh(), 1, 16384, device_kind="TPU v5 lite",
+        aot_probe=probe_fits,
+    )
+    assert out.remat == "none" and probed == ["none"]
+
+    def probe_too_big(pol):
+        probed.append(pol)
+        return int(cap * AOT_PROBE_ACCEPT_MARGIN) + 1
+
+    probed.clear()
+    out = resolve_auto_remat(
+        cfg, strat, _mesh(), 1, 16384, device_kind="TPU v5 lite",
+        aot_probe=probe_too_big,
+    )
+    # Every in-band policy probed and rejected -> the analytic chain's
+    # answer stands (full fits analytically at 16K).
+    assert out.remat == "full" and probed == ["none", "dots"]
+
+    probed.clear()
+    out = resolve_auto_remat(
+        cfg, strat, _mesh(), 1, 16384, device_kind="TPU v5 lite",
+        aot_probe=lambda pol: probed.append(pol) or None,  # compile failed
+    )
+    assert out.remat == "full" and probed == ["none", "dots"]
+
+    # Without a probe, behavior is the pre-probe conservative chain.
+    out = resolve_auto_remat(
+        cfg, strat, _mesh(), 1, 16384, device_kind="TPU v5 lite"
+    )
+    assert out.remat == "full"
+
+
+def test_abstract_step_peak_bytes_smoke(eight_devices):
+    """The abstract AOT probe compiles the real step from ShapeDtypeStructs
+    (no arrays) and returns a positive peak or None — never raises."""
+    from distributed_llm_training_benchmark_framework_tpu.train.step import (
+        abstract_step_peak_bytes,
+    )
+
+    cfg = get_model_config("S", 64, dropout=0.0)
+    mesh = make_mesh((8,), ("data",), devices=jax.devices())
+    peak = abstract_step_peak_bytes(
+        cfg, get_strategy("zero2"), mesh, grad_accum=2, from_table=True,
+        global_micro=8, seq_len=64, dataset_size=64,
+    )
+    assert peak is None or peak > 0
+
+
 def test_resolve_auto_remat_passthrough_non_auto():
     from distributed_llm_training_benchmark_framework_tpu.utils.memory import (
         resolve_auto_remat,
