@@ -62,7 +62,7 @@ def load_results(results_dir: str) -> pd.DataFrame:
             # a baseline suite would dedupe one of them away.
             "tensor_parallel", "sequence_parallel", "pipeline_parallel",
             "pipeline_schedule", "virtual_stages", "expert_parallel",
-            "n_experts", "remat_policy",
+            "n_experts", "remat_policy", "param_dtype", "offload_opt_state",
         ) if c in df.columns
     ]
     df = df.drop_duplicates(subset=key, keep="first")
@@ -82,7 +82,7 @@ def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
             "tier", "per_device_batch", "grad_accum", "attention_impl",
             "tensor_parallel", "sequence_parallel", "pipeline_parallel",
             "pipeline_schedule", "virtual_stages", "expert_parallel",
-            "n_experts",
+            "n_experts", "param_dtype", "offload_opt_state",
         )
         if c in df.columns
     ]

@@ -37,6 +37,7 @@ import os
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -78,6 +79,17 @@ class StrategyConfig:
     # bf16-rounded Adam updates (a stress-tier trade, documented in
     # docs/TROUBLESHOOTING.md).
     param_dtype: str = "f32"
+    # Host-offloaded optimizer (TPU-native analogue of DeepSpeed's
+    # ZeRO-Offload, reference configs/deepspeed/zero3.json offload_optimizer):
+    # fp32 MASTER params + Adam moments live permanently in pinned host
+    # memory and the full update runs ON THE HOST CPU
+    # (jax.experimental.compute_on inside the jitted step); the device holds
+    # only a bf16 compute copy of the params, whose grads stream down and
+    # whose refresh streams back each step. The quality-preserving
+    # alternative to param_dtype='bf16' for models whose fp32 state exceeds
+    # HBM: Adam runs in full fp32 against master weights. Costs per-step
+    # PCIe traffic (~2 x bf16-param bytes); see docs/PERFORMANCE.md.
+    offload_opt_state: bool = False
 
     def describe(self) -> str:
         bits = [
@@ -89,6 +101,8 @@ class StrategyConfig:
             bits.append(f"remat={self.remat}")
         if self.param_dtype != "f32":
             bits.append(f"param_dtype={self.param_dtype}")
+        if self.offload_opt_state:
+            bits.append("opt_offload=pinned_host")
         return f"{self.name}: " + ", ".join(bits)
 
 
@@ -186,6 +200,9 @@ def load_strategy_config(path: str) -> StrategyConfig:
         shard_grads=bool(shard.get("grads", base.shard_grads)),
         shard_opt_state=bool(shard.get("opt_state", base.shard_opt_state)),
         remat=_normalize_remat_field(raw.get("remat", base.remat)),
+        offload_opt_state=bool(
+            raw.get("offload_opt_state", base.offload_opt_state)
+        ),
     )
 
 
@@ -304,6 +321,16 @@ def from_deepspeed_config(raw: Dict[str, Any], strategy_name: str) -> StrategyCo
         # DeepSpeed semantics: gradient_clipping 0 means *disabled*, not
         # "clip everything to zero norm".
         grad_clip = None
+    # ZeRO-Offload: zero_optimization.offload_optimizer.device cpu/nvme
+    # maps onto the pinned-host optimizer offload (reference
+    # configs/deepspeed/zero3.json:12-14 ships the section with "none").
+    # An explicit device (incl. "none") overrides the base strategy in
+    # both directions, like gradient_clipping=0 disables clipping above.
+    ds_off = section("zero_optimization").get("offload_optimizer")
+    if isinstance(ds_off, dict) and "device" in ds_off:
+        offload = ds_off["device"] not in (None, "none")
+    else:
+        offload = base.offload_opt_state
     return dataclasses.replace(
         base,
         learning_rate=num(opt, "lr", base.learning_rate),
@@ -313,16 +340,12 @@ def from_deepspeed_config(raw: Dict[str, Any], strategy_name: str) -> StrategyCo
         warmup_steps=warmup,
         grad_clip=grad_clip,
         precision=precision,
+        offload_opt_state=offload,
     )
 
 
-def make_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
-    """AdamW (+ optional global-norm clip + optional linear warmup).
-
-    Mirrors the reference recipes: bare AdamW(1e-4, wd=0.01) for ddp/fsdp
-    (train_harness.py:328-331); AdamW + WarmupLR(5) + clip 1.0 for the ZeRO
-    arms (configs/deepspeed/zero2.json:2,27-44).
-    """
+def _base_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
+    """The plain AdamW chain (+ optional clip + warmup) for one arm."""
     if strategy.warmup_steps > 0:
         lr = optax.linear_schedule(
             init_value=0.0,
@@ -341,6 +364,122 @@ def make_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
     if strategy.grad_clip is not None:
         tx = optax.chain(optax.clip_by_global_norm(float(strategy.grad_clip)), tx)
     return tx
+
+
+def make_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
+    """AdamW (+ optional global-norm clip + optional linear warmup).
+
+    Mirrors the reference recipes: bare AdamW(1e-4, wd=0.01) for ddp/fsdp
+    (train_harness.py:328-331); AdamW + WarmupLR(5) + clip 1.0 for the ZeRO
+    arms (configs/deepspeed/zero2.json:2,27-44).
+
+    For ``offload_opt_state`` arms the returned transformation's state is
+    ``(fp32_master_params, adamw_state)`` — the ZeRO-Offload layout: the
+    fp32 master weights live WITH the moments in pinned host memory
+    (``opt_state_shardings``), the device keeps only a bf16 compute copy of
+    the params, and the whole update executes on the host
+    (``offload_update_and_apply``). Its ``update`` is deliberately not
+    callable — the step must use ``offload_update_and_apply``.
+    """
+    tx = _base_optimizer(strategy)
+    if not strategy.offload_opt_state:
+        return tx
+
+    def init(params):
+        # Masters are upcast from the bf16 device init, so they START
+        # bf16-rounded (immaterial: the init is random noise); the arm's
+        # quality edge is that every subsequent Adam update ACCUMULATES in
+        # fp32, where the bf16-state arm rounds each step's small update.
+        master = jax.tree.map(
+            lambda p: p.astype(jnp.float32), params
+        )
+        return (master, tx.init(master))
+
+    def update(grads, state, params=None):
+        raise ValueError(
+            "offload_opt_state optimizer state updates on the host — call "
+            "strategies.offload_update_and_apply, not optimizer.update"
+        )
+
+    return optax.GradientTransformation(init, update)
+
+
+def opt_state_shardings(mesh: Mesh, opt_specs, strategy: StrategyConfig):
+    """NamedShardings for the optimizer state, honoring the offload layout:
+    with ``offload_opt_state`` the WHOLE state (clip state, Adam moments,
+    schedule count) lives in pinned host memory; otherwise device HBM."""
+    shardings = named(mesh, opt_specs)
+    if not strategy.offload_opt_state:
+        return shardings
+    if jax.default_backend() != "tpu":
+        # XLA:CPU's SPMD partitioner RET_CHECKs on the pinned_host
+        # placement annotation ("Side-effect HLO must have sharding" on
+        # annotate_device_placement), so the offload arm is TPU-only —
+        # fail with the remedy instead of a partitioner crash.
+        raise ValueError(
+            "offload_opt_state requires a TPU runtime (pinned_host memory "
+            "space + host compute); this backend "
+            f"({jax.default_backend()!r}) cannot partition host-placed "
+            "state. Drop --offload-opt-state, or use --param-dtype bf16 "
+            "for the memory relief."
+        )
+    return jax.tree.map(lambda s: s.with_memory_kind("pinned_host"), shardings)
+
+
+def offload_update_and_apply(
+    strategy: StrategyConfig,
+    grads,
+    opt_state,
+    params,
+    mesh: Mesh,
+    grad_specs,
+    param_specs,
+):
+    """Optimizer update + apply for ``offload_opt_state`` arms: the
+    ZeRO-Offload architecture (reference ``configs/deepspeed/zero3.json``
+    offload_optimizer analogue), TPU-native.
+
+    The fp32 master params and the Adam moments live permanently in pinned
+    host memory; the device holds a bf16 compute copy of the params (the
+    memory win) whose gradients stream down once per step. The FULL optax
+    chain (clip + AdamW + schedule) and ``apply_updates`` run on the host
+    CPU via ``compute_on("device_host")`` in fp32 against the master
+    weights — full-precision Adam, unlike ``--param-dtype bf16`` whose
+    moments and updates round to bf16 — and only the refreshed bf16
+    compute copy streams back. Per-step PCIe traffic: ~2x bf16-params
+    (grads down + compute copy up). Device HBM never holds moments,
+    masters, or update tensors.
+    """
+    from jax.experimental.compute_on import compute_on
+
+    tx = _base_optimizer(strategy)
+    is_spec = lambda x: isinstance(x, P)
+    host = lambda specs: jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec).with_memory_kind("pinned_host"),
+        specs, is_leaf=is_spec,
+    )
+    dev = lambda specs: jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), specs, is_leaf=is_spec
+    )
+    gh = jax.device_put(grads, host(grad_specs))
+    # The compute-copy dtype is the device params' dtype — static trace-time
+    # metadata, so no param data crosses to the host for this.
+    param_dtypes = jax.tree.map(lambda p: p.dtype, params)
+
+    def host_math(g, state):
+        master, inner = state
+        g32 = jax.tree.map(lambda x: x.astype(jnp.float32), g)
+        u, inner2 = tx.update(g32, inner, master)
+        master2 = optax.apply_updates(master, u)
+        compute = jax.tree.map(
+            lambda m, dt: m.astype(dt), master2, param_dtypes
+        )
+        return compute, (master2, inner2)
+
+    compute, new_state = compute_on("device_host")(jax.jit(host_math))(
+        gh, opt_state
+    )
+    return jax.device_put(compute, dev(param_specs)), new_state
 
 
 # ---------------------------------------------------------------------------

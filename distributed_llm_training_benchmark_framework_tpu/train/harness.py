@@ -127,6 +127,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "reference's per-step discipline, N>1 keeps host RPC "
                         "latency out of the timed loop on slow host links")
     # Configs
+    p.add_argument("--offload-opt-state", action="store_true",
+                   help="Host-offload the Adam moments to pinned host memory "
+                        "and run the Adam math on the host CPU (ZeRO-Offload "
+                        "analogue): the fp32-master-weight path for models "
+                        "whose optimizer state exceeds HBM")
     p.add_argument("--param-dtype", choices=["f32", "bf16"], default=None,
                    help="Parameter/Adam-state storage dtype (default: the "
                         "arm's config, normally f32 master weights). bf16 "
@@ -213,10 +218,13 @@ def main(argv=None) -> int:
         enable_debug()
 
     strategy = resolve_strategy(args)
-    if args.param_dtype is not None:
+    if args.param_dtype is not None or args.offload_opt_state:
         import dataclasses as _dc
 
-        strategy = _dc.replace(strategy, param_dtype=args.param_dtype)
+        if args.param_dtype is not None:
+            strategy = _dc.replace(strategy, param_dtype=args.param_dtype)
+        if args.offload_opt_state:
+            strategy = _dc.replace(strategy, offload_opt_state=True)
     dist.setup_distributed(
         master_addr=args.master_addr,
         master_port=args.master_port,

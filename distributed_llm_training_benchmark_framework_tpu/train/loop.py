@@ -386,6 +386,8 @@ def _run_benchmark_impl(
         expert_parallel=ep,
         n_experts=n_experts,
         remat_policy=state.model_config.remat,
+        param_dtype=strategy.param_dtype,
+        offload_opt_state=strategy.offload_opt_state,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

@@ -79,15 +79,23 @@ def _resolve_model_config(
     # that skips that step gets the conservative policy.
     remat = "full" if strategy.remat == "auto" else strategy.remat
     # bf16 parameter storage halves params+grads+Adam state — the knob that
-    # fits tier B on one chip (see StrategyConfig.param_dtype).
+    # fits tier B on one chip (see StrategyConfig.param_dtype). The
+    # ZeRO-Offload arm also runs bf16 DEVICE params — its fp32 master
+    # weights live on the host inside the optimizer state, so device params
+    # are a compute copy by construction.
     param_dtype = (
-        jnp.bfloat16 if getattr(strategy, "param_dtype", "f32") == "bf16"
+        jnp.bfloat16
+        if (
+            getattr(strategy, "param_dtype", "f32") == "bf16"
+            or getattr(strategy, "offload_opt_state", False)
+        )
         else jnp.float32
     )
     return dataclasses.replace(
         model_config, remat=remat, compute_dtype=compute_dtype,
         param_dtype=param_dtype,
     )
+
 
 
 def make_train_step(
@@ -225,6 +233,20 @@ def make_train_step(
             # ZeRO-2: reduce-scatter the gradients into the optimizer shard.
             grads = lax.with_sharding_constraint(grads, strat.named(mesh, grad_sharded_specs))
 
+        if strategy.offload_opt_state:
+            # ZeRO-Offload: fp32 master params + moments live in pinned
+            # host memory, the full update + apply run on the host CPU, and
+            # the device's bf16 compute params are refreshed from the
+            # masters (see strategies.offload_update_and_apply).
+            new_params, new_opt_state = strat.offload_update_and_apply(
+                strategy, grads, opt_state, params, mesh,
+                grad_sharded_specs if (
+                    strategy.shard_grads and not strategy.shard_params
+                ) else param_specs,
+                param_specs,
+            )
+            return new_params, new_opt_state, loss
+
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
 
         if strategy.shard_grads and not strategy.shard_params:
@@ -234,18 +256,19 @@ def make_train_step(
         new_params = optax.apply_updates(params, updates)
         return new_params, new_opt_state, loss
 
+    opt_shardings = strat.opt_state_shardings(mesh, opt_specs, strategy)
     jitted = jax.jit(
         train_step,
         in_shardings=(
             strat.named(mesh, param_specs),
-            strat.named(mesh, opt_specs),
+            opt_shardings,
             NamedSharding(mesh, P()) if from_table
             else NamedSharding(mesh, full_batch_spec),
             None,
         ),
         out_shardings=(
             strat.named(mesh, param_specs),
-            strat.named(mesh, opt_specs),
+            opt_shardings,
             NamedSharding(mesh, P()),
         ),
         donate_argnums=(0, 1),
@@ -328,6 +351,17 @@ def abstract_step_peak_bytes(
 
     params_abs = abstract(params_shape, param_specs)
     opt_abs = abstract(opt_shape, opt_specs)
+    if strategy.offload_opt_state:
+        # The state's host subtree must carry its pinned_host memory kind
+        # abstractly too, or the lowered update mixes memory spaces.
+        opt_shardings = strat.opt_state_shardings(mesh, opt_specs, strategy)
+        opt_abs = jax.tree.map(
+            lambda s_abs, sh: jax.ShapeDtypeStruct(
+                s_abs.shape, s_abs.dtype, sharding=sh
+            ),
+            opt_abs, opt_shardings,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct),
+        )
     if from_table:
         batch_abs = jax.ShapeDtypeStruct(
             (dataset_size, seq_len), jnp.int32,
@@ -411,7 +445,8 @@ def create_train_state(
             out_shardings=strat.named(mesh, param_specs),
         )(jax.random.key(seed))
         opt_state = jax.jit(
-            optimizer.init, out_shardings=strat.named(mesh, opt_specs)
+            optimizer.init,
+            out_shardings=strat.opt_state_shardings(mesh, opt_specs, strategy),
         )(params)
 
     step_fn, aot_compile = make_train_step(

@@ -57,7 +57,9 @@ def peak_hbm_bytes() -> Optional[int]:
     return max(peaks) if peaks else None
 
 
-def measure_peak_hbm(compiled_step=None) -> tuple[float, str]:
+def measure_peak_hbm(
+    compiled_step=None, host_offload: bool = False
+) -> tuple[float, str]:
     """Measured per-device peak memory in GB, with provenance.
 
     Fallback chain (first rung that yields a number wins):
@@ -92,6 +94,32 @@ def measure_peak_hbm(compiled_step=None) -> tuple[float, str]:
         try:
             ma = compiled_step.memory_analysis()
             peak_bytes = int(getattr(ma, "peak_memory_in_bytes", 0))
+            # Host-offload arms only (``host_offload``): the
+            # buffer-assignment peak sums ALL memory spaces, so pinned-host
+            # buffers (fp32 masters + Adam moments) would masquerade as
+            # HBM. Report the device space only — and only when the
+            # subtraction leaves a device-plausible remainder, so an XLA
+            # version whose peak already excludes host space can't be
+            # clamped to a bogus ~0 under an authoritative-sounding tag.
+            # (Host outputs alias the donated host arguments, so only
+            # arguments + temps are subtracted — outputs would
+            # double-count.)
+            host_bytes = sum(
+                int(getattr(ma, f, 0) or 0)
+                for f in (
+                    "host_argument_size_in_bytes",
+                    "host_temp_size_in_bytes",
+                )
+            )
+            if (
+                host_offload
+                and peak_bytes > 0
+                and 0 < host_bytes < peak_bytes
+            ):
+                return (
+                    (peak_bytes - host_bytes) / 1e9,
+                    "xla_buffer_assignment_minus_host",
+                )
             if peak_bytes > 0:
                 return peak_bytes / 1e9, "xla_buffer_assignment"
         except Exception:
@@ -169,6 +197,10 @@ class BenchmarkResult:
     # The remat policy the run actually executed with ("none"/"dots"/"full")
     # — provenance for strategies whose "auto" resolves per-geometry.
     remat_policy: str = "none"
+    # Parameter storage dtype ('f32'/'bf16') and host optimizer offload —
+    # run identity for arms sharing (strategy, tier, seq) geometry.
+    param_dtype: str = "f32"
+    offload_opt_state: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -209,6 +241,8 @@ def compute_result(
     expert_parallel: int = 1,
     n_experts: int = 0,
     remat_policy: str = "none",
+    param_dtype: str = "f32",
+    offload_opt_state: bool = False,
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
@@ -224,7 +258,9 @@ def compute_result(
     tps = tokens_per_step / mean_step if mean_step > 0 else 0.0
     bytes_per_step = per_device_batch * grad_accum * seq_len * 4
     h2d = (bytes_per_step / mean_step) / 1e9 if mean_step > 0 else 0.0
-    peak_gb, peak_method = measure_peak_hbm(compiled_step)
+    peak_gb, peak_method = measure_peak_hbm(
+        compiled_step, host_offload=offload_opt_state
+    )
     from . import flops as flops_mod
 
     tps_per_chip = tps / world_size if world_size else 0.0
@@ -282,6 +318,8 @@ def compute_result(
         expert_parallel=expert_parallel,
         n_experts=n_experts,
         remat_policy=remat_policy,
+        param_dtype=param_dtype,
+        offload_opt_state=offload_opt_state,
     )
 
 

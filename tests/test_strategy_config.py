@@ -178,3 +178,25 @@ def test_resolve_strategy_unknown_format_falls_back(tmp_path, capsys):
     sc = resolve_strategy(_args(strategy_config=str(path)))
     assert sc == get_strategy("zero2")
     assert "not a recognized" in capsys.readouterr().out
+
+
+def test_deepspeed_offload_optimizer_maps_to_offload_opt_state():
+    """zero_optimization.offload_optimizer.device cpu -> pinned-host offload;
+    the reference's shipped "none" stays off (its configs carry the section
+    disabled)."""
+    from distributed_llm_training_benchmark_framework_tpu.parallel.strategies import (
+        from_deepspeed_config,
+    )
+
+    on = from_deepspeed_config(
+        {"zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}}},
+        "zero3",
+    )
+    assert on.offload_opt_state
+    off = from_deepspeed_config(
+        {"zero_optimization": {"stage": 3, "offload_optimizer": {"device": "none"}}},
+        "zero3",
+    )
+    assert not off.offload_opt_state
+    absent = from_deepspeed_config({"zero_optimization": {"stage": 3}}, "zero3")
+    assert not absent.offload_opt_state
