@@ -81,9 +81,7 @@ def _composition_label(r) -> str:
         bits.append(f"ep{max(val('expert_parallel', 1), 1)}x{val('n_experts', 0)}e")
     if r.get("param_dtype") == "bf16":
         bits.append("bf16-params")
-    if r.get("offload_opt_state") is True or str(
-        r.get("offload_opt_state")
-    ).lower() == "true":
+    if str(r.get("offload_opt_state")).lower() == "true":
         bits.append("opt-offload")
     return "+".join(bits) if bits else "-"
 

@@ -27,10 +27,11 @@ Blockwise Transformers"): each device recomputes its block's attention
 probabilities from the saved GLOBAL logsumexp (standard flash backward
 identity), accumulates dq locally, and rotates (k, v, dk, dv) around the
 ring so after N hops every block's dk/dv arrive back at their home device
-fully accumulated. Block compute uses the XLA-fused blockwise einsums —
-measured FASTER than the Pallas backward kernels on v5e
-(flash_attention._jnp_blockwise_bwd notes) — tiled over K so only
-(S_local, block) score tiles materialize.
+fully accumulated. Per-block compute follows the measured S-dependent
+backward crossover (docs/PERFORMANCE.md §11-12): XLA-fused blockwise
+einsum tiles for S_local < 4096, offset-aware Pallas dq / dk+dv kernels
+(1.6-2.1x per block) from 4096 up — the regime multi-chip sequence
+parallelism actually runs in.
 
 Attention-probability dropout uses the flash kernel's absolute-coordinate
 hash (``flash_attention._dropout_keep``) keyed by global (batch*head, row,
@@ -57,6 +58,8 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 from .flash_attention import (
+    _bwd_dq_kernel,
+    _bwd_dkv_kernel,
     _dropout_keep,
     _dropout_threshold,
     _pick_block,
@@ -65,6 +68,7 @@ from .flash_attention import (
     _FWD_BLOCK_Q,
     _FWD_BLOCK_K,
     _BWD_BLOCK_K,
+    _PALLAS_BWD_MIN_SEQ,
 )
 
 NEG_INF = -1e30
@@ -206,6 +210,74 @@ def _block_stats_kernel(
     return m[:, 0, :], l[:, 0, :], o
 
 
+def _block_bwd_kernel(
+    q3, k_b, v_b, do3, lse, delta, seed, q_off, k_off, bh_vec,
+    causal: bool, dropout_rate: float, bq: int, bk: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pallas path for one resident ring block's backward ->
+    (dq_partial, dk_b, dv_b), all fp32 (BH, Sl, D). Runs the SHARED
+    offset-aware flash backward kernels (flash_attention._bwd_dq_kernel /
+    _bwd_dkv_kernel) with this block's global offsets and batch*head
+    indices in SMEM — one kernel implementation serves flash and ring."""
+    BH, Sq, D = q3.shape
+    Sk = k_b.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    offs = jnp.stack([
+        jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)
+    ])
+    lse3 = jnp.broadcast_to(lse[:, None, :], (BH, 8, Sq))
+    delta3 = jnp.broadcast_to(delta[:, None, :], (BH, 8, Sq))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    row = dict(
+        q=pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
+        k=pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
+        stat=pl.BlockSpec((1, 8, bq), lambda b, qi, ki: (b, 0, qi)),
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
+            seq_len=Sq, dropout_rate=dropout_rate,
+        ),
+        out_shape=_vma_struct((BH, Sq, D), jnp.float32, q3, k_b, v_b, do3),
+        grid=(BH, Sq // bq, Sk // bk),
+        in_specs=[smem, smem, smem, row["q"], row["k"], row["k"],
+                  row["q"], row["stat"], row["stat"]],
+        out_specs=row["q"],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(seed, offs, bh_vec, q3, k_b, v_b, do3, lse3, delta3)
+
+    col = dict(
+        q=pl.BlockSpec((1, bq, D), lambda b, ki, qi: (b, qi, 0)),
+        k=pl.BlockSpec((1, bk, D), lambda b, ki, qi: (b, ki, 0)),
+        stat=pl.BlockSpec((1, 8, bq), lambda b, ki, qi: (b, 0, qi)),
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
+            seq_len=Sq, dropout_rate=dropout_rate,
+        ),
+        out_shape=[
+            _vma_struct((BH, Sk, D), jnp.float32, q3, k_b, v_b, do3),
+            _vma_struct((BH, Sk, D), jnp.float32, q3, k_b, v_b, do3),
+        ],
+        grid=(BH, Sk // bk, Sq // bq),
+        in_specs=[smem, smem, smem, col["q"], col["k"], col["k"],
+                  col["q"], col["stat"], col["stat"]],
+        out_specs=[col["k"], col["k"]],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(seed, offs, bh_vec, q3, k_b, v_b, do3, lse3, delta3)
+    return dq, dk, dv
+
+
 def _block_stats_jnp(
     q3, k3, v3, seed, q_off, k_off, bh_vec,
     causal: bool, dropout_rate: float,
@@ -326,8 +398,10 @@ def _ring_fwd(opts, q, k, v, seed):
 def _ring_bwd(opts, res, do):
     """Backward ring pass: recompute per-block probabilities from the saved
     global logsumexp, accumulate dq locally, rotate (k, v, dk, dv) a full
-    cycle so every block's dk/dv land home fully summed. Block compute is
-    the blockwise-einsum flash backward (tiled over K inside each block)."""
+    cycle so every block's dk/dv land home fully summed. Per-block compute
+    follows the measured S-dependent crossover: einsum tiles below
+    _PALLAS_BWD_MIN_SEQ-sized local shards, the shared offset-aware Pallas
+    backward kernels from there up (docs/PERFORMANCE.md §11)."""
     (axis_name, causal, rate, batch_axis, heads_axis,
      interpret, bq, bk, bk_bwd) = opts
     q, k, v, out, lse, seed = res
@@ -354,6 +428,11 @@ def _ring_bwd(opts, res, do):
     rows = q_off + jnp.arange(Sl)
     threshold = _dropout_threshold(rate)
     tile = min(bk_bwd, Sl)
+    # Same S-dependent backward crossover as flash_attention (measured,
+    # docs/PERFORMANCE.md §12): the einsum tiles win at short blocks, the
+    # Pallas kernels from _PALLAS_BWD_MIN_SEQ-sized local shards up — the
+    # regime multi-chip sequence parallelism actually runs in.
+    use_kernels = (not interpret) and Sl >= _PALLAS_BWD_MIN_SEQ
 
     def block_bwd(k_b, v_b, k_off):
         """One resident block's (dq_partial, dk_b, dv_b), tiled over K so
@@ -420,7 +499,13 @@ def _ring_bwd(opts, res, do):
         # originated on (my - t) % n, and so did the dk/dv accumulators
         # riding along with it.
         src = (my - t) % n
-        dq_p, dk_b, dv_b = block_bwd(k_cur, v_cur, src * Sl)
+        if use_kernels:
+            dq_p, dk_b, dv_b = _block_bwd_kernel(
+                q3, k_cur, v_cur, do3, lse, delta, seed, q_off, src * Sl,
+                bh_vec, causal, rate, bq, min(bk_bwd, Sl),
+            )
+        else:
+            dq_p, dk_b, dv_b = block_bwd(k_cur, v_cur, src * Sl)
         dq3 = dq3 + dq_p
         dk_cur = dk_cur + dk_b
         dv_cur = dv_cur + dv_b
