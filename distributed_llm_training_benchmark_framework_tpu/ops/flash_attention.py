@@ -326,32 +326,32 @@ def _flash_fwd_rule(opts, q, k, v, seed):
 
 
 def _bwd_dq_kernel(
-    seed_ref, offs_ref, bhv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-    delta_ref, dq_ref, acc,
+    seed_ref, qoff_ref, koff_ref, bhv_ref, q_ref, k_ref, v_ref, do_ref,
+    lse_ref, delta_ref, dq_ref, acc,
     *, bq: int, bk: int, scale: float, causal: bool,
     seq_len: int, dropout_rate: float,
 ):
     """dq = sum over k blocks of ds @ k, ds = p * (dp - delta) * scale.
 
-    Shared by plain flash ([q_off, k_off] = [0, 0], bhv = arange identity)
-    and ring attention's per-block backward, whose SMEM operands carry the
-    GLOBAL sequence offsets and global batch*head indices so causal masking
-    and the dropout hash see absolute coordinates (one kernel, not two
-    hand-synced copies)."""
+    Shared by plain flash, ring attention's per-block backward, and the
+    zigzag ring layout: the SMEM vectors ``qoff_ref`` (nq,) / ``koff_ref``
+    (nk,) carry each TILE's global base row/col — arange(n)*b for plain
+    flash, shard-offset + arange for contiguous ring blocks, per-half-chunk
+    bases for zigzag — so causal masking and the dropout hash always see
+    absolute coordinates from one kernel implementation. Tiles must be
+    internally contiguous (tile sizes divide the chunk size)."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
-    q_off = offs_ref[0]
-    k_off = offs_ref[1]
+    q_off = qoff_ref[qi]
+    k_off = koff_ref[ki]
 
     @pl.when(ki == 0)
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    live = True if not causal else (
-        q_off + (qi + 1) * bq - 1 >= k_off + ki * bk
-    )
+    live = True if not causal else (q_off + bq - 1 >= k_off)
 
     @pl.when(live)
     def _accumulate():
@@ -366,8 +366,8 @@ def _bwd_dq_kernel(
         ) * scale
         # Narrow coordinate operands: the causal compare and the dropout
         # hash broadcast (bq,1)x(1,bk); the row-fold mix runs per-row only.
-        rows = q_off + qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-        cols = k_off + ki * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        rows = q_off + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = k_off + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         if causal:
             mask = rows >= cols
             s = jnp.where(mask, s, NEG_INF)
@@ -394,30 +394,29 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    seed_ref, offs_ref, bhv_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+    seed_ref, qoff_ref, koff_ref, bhv_ref, q_ref, k_ref, v_ref, do_ref,
+    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
     *, bq: int, bk: int, scale: float, causal: bool,
     seq_len: int, dropout_rate: float,
 ):
     """dk = sum over q blocks of ds^T @ q; dv = sum of (D∘p)^T @ do.
 
-    Shared with ring attention's per-block backward via the same SMEM
-    offset/bh-vector operands as _bwd_dq_kernel (see its docstring)."""
+    Shared with ring attention's per-block backward (contiguous and zigzag
+    layouts) via the same SMEM tile-base vectors as _bwd_dq_kernel (see its
+    docstring)."""
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
-    q_off = offs_ref[0]
-    k_off = offs_ref[1]
+    q_off = qoff_ref[qi]
+    k_off = koff_ref[ki]
 
     @pl.when(qi == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    live = True if not causal else (
-        q_off + (qi + 1) * bq - 1 >= k_off + ki * bk
-    )
+    live = True if not causal else (q_off + bq - 1 >= k_off)
 
     @pl.when(live)
     def _accumulate():
@@ -432,8 +431,8 @@ def _bwd_dkv_kernel(
         ) * scale
         # Narrow coordinate operands: the causal compare and the dropout
         # hash broadcast (bq,1)x(1,bk); the row-fold mix runs per-row only.
-        rows = q_off + qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-        cols = k_off + ki * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        rows = q_off + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = k_off + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         if causal:
             mask = rows >= cols
             s = jnp.where(mask, s, NEG_INF)
@@ -573,9 +572,11 @@ def _flash_bwd_rule(opts, res, do):
     delta3 = jnp.broadcast_to(delta[:, None, :], (BH, 8, S))
 
     seed_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
-    # Plain flash = the shared offset-aware kernels at zero offsets with an
-    # identity batch*head index vector (ring attention feeds global ones).
-    offs = jnp.zeros((2,), jnp.int32)
+    # Plain flash = the shared tile-base-aware kernels at identity bases
+    # (tile i starts at row i*b) with an identity batch*head index vector
+    # (ring attention feeds global ones).
+    qoffs = jnp.arange(S // bq, dtype=jnp.int32) * bq
+    koffs = jnp.arange(S // bk, dtype=jnp.int32) * bk
     bhv = jnp.arange(BH, dtype=jnp.int32)
     row_specs = dict(
         q=pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
@@ -589,7 +590,7 @@ def _flash_bwd_rule(opts, res, do):
         ),
         out_shape=_vma_struct((BH, S, D), q.dtype, q, k, v, do),
         grid=(BH, S // bq, S // bk),
-        in_specs=[seed_spec, seed_spec, seed_spec,
+        in_specs=[seed_spec, seed_spec, seed_spec, seed_spec,
                   row_specs["q"], row_specs["k"], row_specs["k"],
                   row_specs["q"], row_specs["stat"], row_specs["stat"]],
         out_specs=row_specs["q"],
@@ -598,7 +599,7 @@ def _flash_bwd_rule(opts, res, do):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(seed, offs, bhv, q, k, v, do, lse3, delta3)
+    )(seed, qoffs, koffs, bhv, q, k, v, do, lse3, delta3)
 
     col_specs = dict(
         q=pl.BlockSpec((1, bq, D), lambda b, ki, qi: (b, qi, 0)),
@@ -615,7 +616,7 @@ def _flash_bwd_rule(opts, res, do):
             _vma_struct((BH, S, D), v.dtype, q, k, v, do),
         ],
         grid=(BH, S // bk, S // bq),
-        in_specs=[seed_spec, seed_spec, seed_spec,
+        in_specs=[seed_spec, seed_spec, seed_spec, seed_spec,
                   col_specs["q"], col_specs["k"], col_specs["k"],
                   col_specs["q"], col_specs["stat"], col_specs["stat"]],
         out_specs=[col_specs["k"], col_specs["k"]],
@@ -627,7 +628,7 @@ def _flash_bwd_rule(opts, res, do):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(seed, offs, bhv, q, k, v, do, lse3, delta3)
+    )(seed, qoffs, koffs, bhv, q, k, v, do, lse3, delta3)
 
     return dq, dk, dv, seed_ct
 

@@ -75,25 +75,26 @@ NEG_INF = -1e30
 
 
 def _ring_fwd_block_kernel(
-    seed_ref, offs_ref, bhv_ref, q_ref, k_ref, v_ref,
+    seed_ref, qoff_ref, koff_ref, bhv_ref, q_ref, k_ref, v_ref,
     m_ref, l_ref, o_ref, m_scr, l_scr, acc_scr,
     *, bq: int, bk: int, scale: float, causal: bool, dropout_rate: float,
 ):
     """Flash forward tile pass emitting UNNORMALIZED (m, l, o) for one ring
     block: identical math to ``flash_attention._flash_fwd_kernel`` except
-    (a) row/col coordinates are offset by the traced global positions in
-    SMEM (``offs_ref`` = [q_off, k_off]) so causal masking and the dropout
-    hash see absolute coordinates, (b) the per-grid-row global batch*head
-    index comes from the SMEM vector ``bhv_ref`` (data/tensor-parallel
-    shards feed their global offsets in), and (c) no normalization — the
-    ring merge outside combines blocks, exactly like the kernel's own
-    k-block accumulation combines tiles."""
+    (a) row/col coordinates come from per-TILE global base vectors in SMEM
+    (``qoff_ref`` (nq,) / ``koff_ref`` (nk,) — shard offset + arange for
+    contiguous ring blocks, per-half-chunk bases for the zigzag layout) so
+    causal masking and the dropout hash see absolute coordinates, (b) the
+    per-grid-row global batch*head index comes from the SMEM vector
+    ``bhv_ref`` (data/tensor-parallel shards feed their global offsets in),
+    and (c) no normalization — the ring merge outside combines blocks,
+    exactly like the kernel's own k-block accumulation combines tiles."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
-    q_off = offs_ref[0]
-    k_off = offs_ref[1]
+    q_off = qoff_ref[qi]
+    k_off = koff_ref[ki]
 
     @pl.when(ki == 0)
     def _init():
@@ -104,9 +105,7 @@ def _ring_fwd_block_kernel(
     # Causal skip by GLOBAL position: a k tile strictly above the diagonal
     # contributes nothing. With ring offsets this also skips every tile of a
     # block that sits entirely in this Q shard's future.
-    live = True if not causal else (
-        q_off + (qi + 1) * bq - 1 >= k_off + ki * bk
-    )
+    live = True if not causal else (q_off + bq - 1 >= k_off)
 
     @pl.when(live)
     def _accumulate():
@@ -117,8 +116,8 @@ def _ring_fwd_block_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (bq, bk) fp32
 
-        rows = q_off + qi * bq + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-        cols = k_off + ki * bk + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        rows = q_off + lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+        cols = k_off + lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         if causal:
             mask = rows >= cols
             s = jnp.where(mask, s, NEG_INF)
@@ -163,17 +162,15 @@ def _ring_fwd_block_kernel(
 
 
 def _block_stats_kernel(
-    q3, k3, v3, seed, q_off, k_off, bh_vec,
+    q3, k3, v3, seed, qoffs, koffs, bh_vec,
     causal: bool, dropout_rate: float, bq: int, bk: int,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Pallas path: (BH, Sq, D) x (BH, Sk, D) -> m, l (BH, Sq) f32 and
-    unnormalized o (BH, Sq, D) f32."""
+    unnormalized o (BH, Sq, D) f32. ``qoffs``/``koffs`` are per-tile global
+    base vectors ((Sq//bq,) / (Sk//bk,) int32)."""
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
     scale = 1.0 / (D ** 0.5)
-    offs = jnp.stack([
-        jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)
-    ])
     m, l, o = pl.pallas_call(
         functools.partial(
             _ring_fwd_block_kernel, bq=bq, bk=bk, scale=scale,
@@ -187,7 +184,8 @@ def _block_stats_kernel(
         grid=(BH, Sq // bq, Sk // bk),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # seed (1,) uint32
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # [q_off, k_off] int32
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # q tile bases (nq,)
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # k tile bases (nk,)
             pl.BlockSpec(memory_space=pltpu.SMEM),  # global bh ids (BH,)
             pl.BlockSpec((1, bq, D), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, bk, D), lambda b, qi, ki: (b, ki, 0)),
@@ -206,12 +204,12 @@ def _block_stats_kernel(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-    )(seed, offs, bh_vec, q3, k3, v3)
+    )(seed, qoffs, koffs, bh_vec, q3, k3, v3)
     return m[:, 0, :], l[:, 0, :], o
 
 
 def _block_bwd_kernel(
-    q3, k_b, v_b, do3, lse, delta, seed, q_off, k_off, bh_vec,
+    q3, k_b, v_b, do3, lse, delta, seed, qoffs, koffs, bh_vec,
     causal: bool, dropout_rate: float, bq: int, bk: int,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Pallas path for one resident ring block's backward ->
@@ -222,9 +220,6 @@ def _block_bwd_kernel(
     BH, Sq, D = q3.shape
     Sk = k_b.shape[1]
     scale = 1.0 / (D ** 0.5)
-    offs = jnp.stack([
-        jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)
-    ])
     lse3 = jnp.broadcast_to(lse[:, None, :], (BH, 8, Sq))
     delta3 = jnp.broadcast_to(delta[:, None, :], (BH, 8, Sq))
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -240,14 +235,14 @@ def _block_bwd_kernel(
         ),
         out_shape=_vma_struct((BH, Sq, D), jnp.float32, q3, k_b, v_b, do3),
         grid=(BH, Sq // bq, Sk // bk),
-        in_specs=[smem, smem, smem, row["q"], row["k"], row["k"],
+        in_specs=[smem, smem, smem, smem, row["q"], row["k"], row["k"],
                   row["q"], row["stat"], row["stat"]],
         out_specs=row["q"],
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-    )(seed, offs, bh_vec, q3, k_b, v_b, do3, lse3, delta3)
+    )(seed, qoffs, koffs, bh_vec, q3, k_b, v_b, do3, lse3, delta3)
 
     col = dict(
         q=pl.BlockSpec((1, bq, D), lambda b, ki, qi: (b, qi, 0)),
@@ -264,7 +259,7 @@ def _block_bwd_kernel(
             _vma_struct((BH, Sk, D), jnp.float32, q3, k_b, v_b, do3),
         ],
         grid=(BH, Sk // bk, Sq // bq),
-        in_specs=[smem, smem, smem, col["q"], col["k"], col["k"],
+        in_specs=[smem, smem, smem, smem, col["q"], col["k"], col["k"],
                   col["q"], col["stat"], col["stat"]],
         out_specs=[col["k"], col["k"]],
         scratch_shapes=[
@@ -274,26 +269,27 @@ def _block_bwd_kernel(
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
-    )(seed, offs, bh_vec, q3, k_b, v_b, do3, lse3, delta3)
+    )(seed, qoffs, koffs, bh_vec, q3, k_b, v_b, do3, lse3, delta3)
     return dq, dk, dv
 
 
 def _block_stats_jnp(
-    q3, k3, v3, seed, q_off, k_off, bh_vec,
+    q3, k3, v3, seed, row_idx, col_idx, bh_vec,
     causal: bool, dropout_rate: float,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Einsum path with the kernel's exact semantics, for backends where the
     Pallas interpreter cannot run inside vma-carrying manual regions (the
     CPU test meshes — same limitation flash_attention._jnp_reference_forward
-    covers)."""
+    covers). ``row_idx``/``col_idx`` are per-row GLOBAL index vectors
+    ((Sq,) / (Sk,) int32) — contiguous or zigzag."""
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
     scale = 1.0 / (D ** 0.5)
     s = jnp.einsum(
         "bqd,bkd->bqk", q3, k3, preferred_element_type=jnp.float32
     ) * scale
-    rows = q_off + lax.broadcasted_iota(jnp.int32, (Sq, 1), 0)
-    cols = k_off + lax.broadcasted_iota(jnp.int32, (1, Sk), 1)
+    rows = row_idx.astype(jnp.int32)[:, None]
+    cols = col_idx.astype(jnp.int32)[None, :]
     if causal:
         mask = (rows >= cols)[None]
         s = jnp.where(mask, s, NEG_INF)
@@ -315,6 +311,67 @@ def _block_stats_jnp(
         preferred_element_type=jnp.float32,
     )
     return m, l, o
+
+
+
+def _zig_chunk_bases(c, n, h):
+    """Global start rows of device ``c``'s two zigzag half-chunks: chunk c
+    and chunk 2n-1-c (h tokens each). ``c`` may be traced."""
+    return (c * h, (2 * n - 1 - c) * h)
+
+
+def _bases_to_tiles(bases, h: int, b: int):
+    """Per-tile global base vector from per-chunk bases (each chunk h rows,
+    tile size b, b | h): concat over chunks of base + arange(h//b)*b."""
+    per = h // b
+    return jnp.concatenate([
+        jnp.asarray(base, jnp.int32) + jnp.arange(per, dtype=jnp.int32) * b
+        for base in bases
+    ])
+
+
+def _bases_to_rows(bases, h: int):
+    """Per-row global index vector from per-chunk bases."""
+    return jnp.concatenate([
+        jnp.asarray(base, jnp.int32) + jnp.arange(h, dtype=jnp.int32)
+        for base in bases
+    ])
+
+
+def _zig_exchange(x3, axis_name: str, n: int, my, inverse: bool = False):
+    """Redistribute (BH, Sl, D) half-chunks between the contiguous layout
+    (device c holds chunks 2c, 2c+1) and the zigzag layout (device c holds
+    chunks c, 2n-1-c — Brandon et al. 2023 "striped"/zigzag causal load
+    balancing): each device's triangular work becomes ~equal, so no ring
+    hop waits on the last device's full diagonal. Two ppermutes each way
+    (one per half), ~one extra hop-equivalent of traffic per exchange.
+    """
+    zig = lambda g: g if g < n else 2 * n - 1 - g
+    h = x3.shape[1] // 2
+    lo, hi = x3[:, :h], x3[:, h:]  # axis 1 = rows; trailing dims pass through
+    even = (my % 2) == 0
+    if not inverse:
+        # contiguous -> zigzag: device c sends chunk 2c on ring A, chunk
+        # 2c+1 on ring B; zigzag device d's low chunk (d) arrives on A iff
+        # d is even, and its high chunk (2n-1-d) on the other.
+        perm_a = [(c, zig(2 * c)) for c in range(n)]
+        perm_b = [(c, zig(2 * c + 1)) for c in range(n)]
+        recv_a = lax.ppermute(lo, axis_name, perm_a)
+        recv_b = lax.ppermute(hi, axis_name, perm_b)
+        new_lo = jnp.where(even, recv_a, recv_b)
+        new_hi = jnp.where(even, recv_b, recv_a)
+    else:
+        # zigzag -> contiguous: ring A carries the EVEN global chunk each
+        # device holds (its low chunk if the device index is even, else its
+        # high chunk), ring B the odd one; contiguous device c receives
+        # chunk 2c on A (its low half) and 2c+1 on B.
+        perm_a = [(zig(2 * c), c) for c in range(n)]
+        perm_b = [(zig(2 * c + 1), c) for c in range(n)]
+        send_a = jnp.where(even, lo, hi)
+        send_b = jnp.where(even, hi, lo)
+        new_lo = lax.ppermute(send_a, axis_name, perm_a)
+        new_hi = lax.ppermute(send_b, axis_name, perm_b)
+    return jnp.concatenate([new_lo, new_hi], axis=1)
 
 
 def _global_bh_vec(B: int, H: int, b_off, h_off, n_heads: int) -> jax.Array:
@@ -345,7 +402,7 @@ def _ring_fwd(opts, q, k, v, seed):
     """Forward ring pass over (B, Sl, H, D) local shards -> normalized out
     plus the (BH, Sl) global logsumexp residual the backward needs."""
     (axis_name, causal, rate, batch_axis, heads_axis,
-     interpret, bq, bk, bk_bwd) = opts
+     interpret, bq, bk, bk_bwd, zig) = opts
     B, Sl, H, D = q.shape
     n, my, b_off, h_off, n_heads = _ring_offsets(
         axis_name, batch_axis, heads_axis, B, H
@@ -357,7 +414,19 @@ def _ring_fwd(opts, q, k, v, seed):
         return t.transpose(0, 2, 1, 3).reshape(B * H, Sl, D)
 
     q3, k3, v3 = to3(q), to3(k), to3(v)
-    q_off = my * Sl
+    if zig:
+        # Causal load balancing: redistribute to the zigzag layout so every
+        # device's triangle work is ~equal (see _zig_exchange). Global
+        # coordinates flow through the per-chunk base vectors, so masking
+        # and dropout stay bit-identical to flash.
+        q3 = _zig_exchange(q3, axis_name, n, my)
+        k3 = _zig_exchange(k3, axis_name, n, my)
+        v3 = _zig_exchange(v3, axis_name, n, my)
+        h = Sl // 2
+        q_bases = _zig_chunk_bases(my, n, h)
+    else:
+        h = Sl
+        q_bases = (my * Sl,)
     m_run = jnp.full((B * H, Sl), NEG_INF, jnp.float32)
     l_run = jnp.zeros((B * H, Sl), jnp.float32)
     o_run = jnp.zeros((B * H, Sl, D), jnp.float32)
@@ -368,13 +437,16 @@ def _ring_fwd(opts, q, k, v, seed):
     for t in range(n):
         # After t forward hops the resident block originated on (my - t) % n.
         src = (my - t) % n
+        k_bases = _zig_chunk_bases(src, n, h) if zig else (src * Sl,)
         if interpret:
             m_b, l_b, o_b = _block_stats_jnp(
-                q3, k_cur, v_cur, seed, q_off, src * Sl, bh_vec, causal, rate
+                q3, k_cur, v_cur, seed, _bases_to_rows(q_bases, h),
+                _bases_to_rows(k_bases, h), bh_vec, causal, rate,
             )
         else:
             m_b, l_b, o_b = _block_stats_kernel(
-                q3, k_cur, v_cur, seed, q_off, src * Sl, bh_vec, causal,
+                q3, k_cur, v_cur, seed, _bases_to_tiles(q_bases, h, bq),
+                _bases_to_tiles(k_bases, h, bk), bh_vec, causal,
                 rate, bq, bk,
             )
         # Merge online-softmax statistics, exactly as the kernel merges its
@@ -390,7 +462,9 @@ def _ring_fwd(opts, q, k, v, seed):
             v_cur = lax.ppermute(v_cur, axis_name, perm)
     l_safe = jnp.where(l_run == 0.0, 1.0, l_run)
     out3 = (o_run / l_safe[..., None]).astype(q.dtype)
-    lse = m_run + jnp.log(l_safe)  # (BH, Sl) fp32, GLOBAL logsumexp
+    lse = m_run + jnp.log(l_safe)  # (BH, Sl) fp32, zigzag-ordered when zig
+    if zig:
+        out3 = _zig_exchange(out3, axis_name, n, my, inverse=True)
     out = out3.reshape(B, H, Sl, D).transpose(0, 2, 1, 3)
     return out, (q, k, v, out, lse, seed)
 
@@ -403,7 +477,7 @@ def _ring_bwd(opts, res, do):
     _PALLAS_BWD_MIN_SEQ-sized local shards, the shared offset-aware Pallas
     backward kernels from there up (docs/PERFORMANCE.md §11)."""
     (axis_name, causal, rate, batch_axis, heads_axis,
-     interpret, bq, bk, bk_bwd) = opts
+     interpret, bq, bk, bk_bwd, zig) = opts
     q, k, v, out, lse, seed = res
     B, Sl, H, D = q.shape
     n, my, b_off, h_off, n_heads = _ring_offsets(
@@ -422,29 +496,46 @@ def _ring_bwd(opts, res, do):
         return t.transpose(0, 2, 1, 3).reshape(B * H, Sl, D)
 
     q3, k3, v3, out3, do3 = to3(q), to3(k), to3(v), to3(out), to3(do)
-    dof = do3.astype(cd)
+    # delta is a per-row reduction, invariant to row reordering — compute
+    # it in the contiguous layout and exchange the (BH, Sl) result, D times
+    # cheaper than exchanging the full out3 activation.
     delta = jnp.sum(do3.astype(f32) * out3.astype(f32), axis=-1)  # (BH, Sl)
-    q_off = my * Sl
-    rows = q_off + jnp.arange(Sl)
+    if zig:
+        # The forward computed (and saved lse) in the zigzag row order;
+        # re-enter it for the backward and leave it again at the end.
+        q3 = _zig_exchange(q3, axis_name, n, my)
+        k3 = _zig_exchange(k3, axis_name, n, my)
+        v3 = _zig_exchange(v3, axis_name, n, my)
+        do3 = _zig_exchange(do3, axis_name, n, my)
+        delta = _zig_exchange(delta, axis_name, n, my)
+        h = Sl // 2
+        q_bases = _zig_chunk_bases(my, n, h)
+    else:
+        h = Sl
+        q_bases = (my * Sl,)
+    dof = do3.astype(cd)
+    rows = _bases_to_rows(q_bases, h)
     threshold = _dropout_threshold(rate)
-    tile = min(bk_bwd, Sl)
+    tile = min(bk_bwd, h)
     # Same S-dependent backward crossover as flash_attention (measured,
     # docs/PERFORMANCE.md §12): the einsum tiles win at short blocks, the
     # Pallas kernels from _PALLAS_BWD_MIN_SEQ-sized local shards up — the
     # regime multi-chip sequence parallelism actually runs in.
     use_kernels = (not interpret) and Sl >= _PALLAS_BWD_MIN_SEQ
 
-    def block_bwd(k_b, v_b, k_off):
+    def block_bwd(k_b, v_b, k_rows):
         """One resident block's (dq_partial, dk_b, dv_b), tiled over K so
         only (Sl, tile) score tiles materialize — flash_attention.
-        _jnp_blockwise_bwd restricted to this block, with global offsets."""
+        _jnp_blockwise_bwd restricted to this block, with global row/col
+        index vectors (contiguous or zigzag)."""
         nt = Sl // tile
         ks = k_b.reshape(B * H, nt, tile, D).transpose(1, 0, 2, 3)
         vs = v_b.reshape(B * H, nt, tile, D).transpose(1, 0, 2, 3)
+        col_tiles = k_rows.reshape(nt, tile)
 
         def one_tile(dq_acc, blk):
             ti, k_t, v_t = blk
-            cols = k_off + ti * tile + jnp.arange(tile)
+            cols = jnp.take(col_tiles, ti, axis=0)
             s = jnp.einsum(
                 "bqd,bkd->bqk", q3, k_t, preferred_element_type=f32
             ) * scale
@@ -499,13 +590,18 @@ def _ring_bwd(opts, res, do):
         # originated on (my - t) % n, and so did the dk/dv accumulators
         # riding along with it.
         src = (my - t) % n
+        k_bases = _zig_chunk_bases(src, n, h) if zig else (src * Sl,)
         if use_kernels:
             dq_p, dk_b, dv_b = _block_bwd_kernel(
-                q3, k_cur, v_cur, do3, lse, delta, seed, q_off, src * Sl,
-                bh_vec, causal, rate, bq, min(bk_bwd, Sl),
+                q3, k_cur, v_cur, do3, lse, delta, seed,
+                _bases_to_tiles(q_bases, h, bq),
+                _bases_to_tiles(k_bases, h, tile),
+                bh_vec, causal, rate, bq, tile,
             )
         else:
-            dq_p, dk_b, dv_b = block_bwd(k_cur, v_cur, src * Sl)
+            dq_p, dk_b, dv_b = block_bwd(
+                k_cur, v_cur, _bases_to_rows(k_bases, h)
+            )
         dq3 = dq3 + dq_p
         dk_cur = dk_cur + dk_b
         dv_cur = dv_cur + dv_b
@@ -517,6 +613,11 @@ def _ring_bwd(opts, res, do):
             v_cur = lax.ppermute(v_cur, axis_name, perm)
         dk_cur = lax.ppermute(dk_cur, axis_name, perm)
         dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+
+    if zig:
+        dq3 = _zig_exchange(dq3, axis_name, n, my, inverse=True)
+        dk_cur = _zig_exchange(dk_cur, axis_name, n, my, inverse=True)
+        dv_cur = _zig_exchange(dv_cur, axis_name, n, my, inverse=True)
 
     def back4(t3, dtype):  # (B*H, Sl, D) -> (B, Sl, H, D)
         return t3.reshape(B, H, Sl, D).transpose(0, 2, 1, 3).astype(dtype)
@@ -548,6 +649,7 @@ def ring_attention_sharded(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     block_k_bwd: Optional[int] = None,
+    zigzag: Optional[bool] = None,
 ) -> jax.Array:
     """Ring attention body; call inside shard_map with seq sharded on axis_name.
 
@@ -555,6 +657,15 @@ def ring_attention_sharded(
     head dims are sharded over, so dropout-mask coordinates are GLOBAL
     (batch, head) indices — without them, same-local-index examples on
     different data shards would share masks.
+
+    ``zigzag`` (default: auto — on when ``causal``, the ring has >1
+    device, the local shard is even, and any explicit block sizes divide
+    the half-chunk) redistributes half-chunks so device c owns global chunks
+    (c, 2n-1-c): causal triangle work becomes ~equal per device per hop
+    instead of the contiguous layout's last-device-does-everything skew
+    (~2x wall-clock at large rings). Purely internal — inputs/outputs stay
+    in the contiguous layout, and global coordinates keep dropout masks
+    bit-identical to flash. Pass ``zigzag=False`` to force contiguous.
 
     On TPU each ring hop runs the Pallas flash block kernel (VMEM-resident
     score tiles); elsewhere (CPU test meshes, where the Pallas interpreter
@@ -570,21 +681,45 @@ def ring_attention_sharded(
     else:
         seed = jnp.asarray(dropout_seed, jnp.uint32).reshape((1,))
     interpret = jax.default_backend() != "tpu"
-    bq = block_q or _pick_block(Sl, _FWD_BLOCK_Q)
-    bk = block_k or _pick_block(Sl, _FWD_BLOCK_K)
-    bk_bwd = block_k_bwd or _pick_block(Sl, _BWD_BLOCK_K)
-    if Sl % bq != 0 or Sl % bk != 0 or Sl % bk_bwd != 0:
-        # Same contract as flash_attention, against the LOCAL shard: a
+    n = lax.axis_size(axis_name)
+    if zigzag is None:
+        zig = causal and n > 1 and Sl % 2 == 0
+        # Auto mode must never turn a previously-valid config into an
+        # error: explicit block sizes that divide the shard but not the
+        # half-chunk fall back to the contiguous layout.
+        if zig and any(
+            b is not None and (Sl // 2) % b != 0
+            for b in (block_q, block_k, block_k_bwd)
+        ):
+            zig = False
+    else:
+        zig = bool(zigzag) and n > 1
+        if zig and Sl % 2 != 0:
+            raise ValueError(
+                f"zigzag=True needs an even local shard, got S/sp={Sl} "
+                f"over '{axis_name}' (the layout splits each shard into "
+                "two half-chunks)"
+            )
+    # Blocks tile one CHUNK: the whole shard normally, a half-chunk under
+    # zigzag (tiles must not straddle the half boundary — their rows would
+    # not be globally contiguous).
+    chunk = Sl // 2 if zig else Sl
+    bq = block_q or _pick_block(chunk, _FWD_BLOCK_Q)
+    bk = block_k or _pick_block(chunk, _FWD_BLOCK_K)
+    bk_bwd = block_k_bwd or _pick_block(chunk, _BWD_BLOCK_K)
+    if chunk % bq != 0 or chunk % bk != 0 or chunk % bk_bwd != 0:
+        # Same contract as flash_attention, against the LOCAL chunk: a
         # non-dividing (or oversized) block would silently truncate the
-        # kernel grid (Sl // bq floor) and compute wrong attention.
+        # kernel grid and compute wrong attention.
         raise ValueError(
             f"block sizes (block_q={bq}, block_k={bk}, block_k_bwd="
-            f"{bk_bwd}) must divide the local sequence shard "
-            f"S/sp={Sl} (global S sharded over '{axis_name}')"
+            f"{bk_bwd}) must divide the local chunk {chunk} "
+            f"(S/sp={Sl} over '{axis_name}'"
+            + (", halved by the zigzag causal layout)" if zig else ")")
         )
     opts = (
         axis_name, causal, dropout_rate, batch_axis, heads_axis,
-        interpret, bq, bk, bk_bwd,
+        interpret, bq, bk, bk_bwd, zig,
     )
     return _ring(opts, q, k, v, seed)
 

@@ -324,6 +324,88 @@ def test_ring_full_grads_match_reference(causal, eight_devices):
 
 
 @pytest.mark.slow
+def test_ring_zigzag_matches_contiguous_and_flash(eight_devices):
+    """The causal zigzag layout (auto-on) is purely internal: same output
+    as zigzag=False and as the flash kernel, including DROPOUT — the
+    half-chunk exchange must keep every row's global coordinates, or the
+    hash mask would shift."""
+    from distributed_llm_training_benchmark_framework_tpu.ops.ring_attention import (
+        ring_attention_sharded,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    rate = 0.25
+    B, S, H, D = 2, 128, 4, 32
+    mesh = make_mesh((4,), ("seq",), devices=jax.devices()[:4])
+    q, k, v = qkv(B=B, S=S, H=H, D=D)
+    seed = jnp.asarray(321, jnp.uint32)
+
+    def ring_call(zz):
+        body = lambda a, b, c: ring_attention_sharded(
+            a, b, c, axis_name="seq", causal=True,
+            dropout_rate=rate, dropout_seed=seed, zigzag=zz,
+        )
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"),
+        )(q, k, v)
+
+    with jax.set_mesh(mesh):
+        out_zig = ring_call(None)   # auto -> zigzag (causal, n=4)
+        out_cont = ring_call(False)
+    np.testing.assert_allclose(
+        np.asarray(out_zig), np.asarray(out_cont), rtol=2e-3, atol=2e-3
+    )
+    out_flash = flash_attention(
+        q, k, v, causal=True, interpret=True, block_q=32, block_k=32,
+        dropout_rate=rate, dropout_seed=seed,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_zig), np.asarray(out_flash), rtol=2e-3, atol=2e-3
+    )
+
+
+@pytest.mark.slow
+def test_ring_zigzag_full_grads(eight_devices):
+    """Causal zigzag grads (dq, dk, dv) — the backward re-enters the zigzag
+    layout, rotates dk/dv home, and inverse-exchanges back to contiguous."""
+    from distributed_llm_training_benchmark_framework_tpu.ops.ring_attention import (
+        ring_attention_sharded,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    B, S, H, D = 1, 64, 2, 16
+    mesh = make_mesh((4,), ("seq",), devices=jax.devices()[:4])
+    q, k, v = qkv(B=B, S=S, H=H, D=D)
+
+    def ring_loss(q, k, v):
+        body = lambda a, b, c: ring_attention_sharded(
+            a, b, c, axis_name="seq", causal=True,
+        )
+        o = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"),
+        )(q, k, v)
+        w = jnp.arange(o.size, dtype=jnp.float32).reshape(o.shape) / o.size
+        return (o.astype(jnp.float32) * w).sum()
+
+    def ref_loss(q, k, v):
+        o = reference_attention(q, k, v, causal=True)
+        w = jnp.arange(o.size, dtype=jnp.float32).reshape(o.shape) / o.size
+        return (o.astype(jnp.float32) * w).sum()
+
+    with jax.set_mesh(mesh):
+        g1 = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3, err_msg=name
+        )
+
+
+@pytest.mark.slow
 def test_ring_full_grads_with_dropout(eight_devices):
     """Full (dq, dk, dv) parity vs the materialized masked reference with
     dropout: the backward ring regenerates the keep mask from coordinates."""
