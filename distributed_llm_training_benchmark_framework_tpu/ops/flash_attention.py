@@ -329,7 +329,7 @@ def _bwd_dq_kernel(
     seed_ref, qoff_ref, koff_ref, bhv_ref, q_ref, k_ref, v_ref, do_ref,
     lse_ref, delta_ref, dq_ref, acc,
     *, bq: int, bk: int, scale: float, causal: bool,
-    seq_len: int, dropout_rate: float,
+    dropout_rate: float,
 ):
     """dq = sum over k blocks of ds @ k, ds = p * (dp - delta) * scale.
 
@@ -397,7 +397,7 @@ def _bwd_dkv_kernel(
     seed_ref, qoff_ref, koff_ref, bhv_ref, q_ref, k_ref, v_ref, do_ref,
     lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
     *, bq: int, bk: int, scale: float, causal: bool,
-    seq_len: int, dropout_rate: float,
+    dropout_rate: float,
 ):
     """dk = sum over q blocks of ds^T @ q; dv = sum of (D∘p)^T @ do.
 
@@ -586,7 +586,7 @@ def _flash_bwd_rule(opts, res, do):
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
-            seq_len=S, dropout_rate=rate,
+            dropout_rate=rate,
         ),
         out_shape=_vma_struct((BH, S, D), q.dtype, q, k, v, do),
         grid=(BH, S // bq, S // bk),
@@ -609,7 +609,7 @@ def _flash_bwd_rule(opts, res, do):
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
-            seq_len=S, dropout_rate=rate,
+            dropout_rate=rate,
         ),
         out_shape=[
             _vma_struct((BH, S, D), k.dtype, q, k, v, do),

@@ -231,7 +231,7 @@ def _block_bwd_kernel(
     dq = pl.pallas_call(
         functools.partial(
             _bwd_dq_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
-            seq_len=Sq, dropout_rate=dropout_rate,
+            dropout_rate=dropout_rate,
         ),
         out_shape=_vma_struct((BH, Sq, D), jnp.float32, q3, k_b, v_b, do3),
         grid=(BH, Sq // bq, Sk // bk),
@@ -252,7 +252,7 @@ def _block_bwd_kernel(
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel, bq=bq, bk=bk, scale=scale, causal=causal,
-            seq_len=Sq, dropout_rate=dropout_rate,
+            dropout_rate=dropout_rate,
         ),
         out_shape=[
             _vma_struct((BH, Sk, D), jnp.float32, q3, k_b, v_b, do3),

@@ -321,7 +321,10 @@ def interleaved_loss_and_grads(
     # See the module docstring: XLA:CPU's collective rendezvous spans all
     # local devices per instruction, so 'seq' collectives inside the
     # device-varying switch deadlock there. Run all unit kinds and mask.
-    uniform_units = sp > 1 and jax.default_backend() == "cpu"
+    # Keyed on backend != 'tpu' (not == 'cpu'): only TPU's per-core SPMD
+    # rendezvous is validated for collectives inside lax.switch, so any
+    # other backend (e.g. GPU) gets the conservative uniform path too.
+    uniform_units = sp > 1 and jax.default_backend() != "tpu"
     PV = n_stages * V
     Lc = config.n_layer // PV
     n_micro = batch.shape[0]

@@ -111,10 +111,21 @@ def measure_peak_hbm(
                     "host_temp_size_in_bytes",
                 )
             )
+            # The remainder must still be device-plausible: at minimum the
+            # device-resident arguments (compute params, dataset, grads)
+            # live in HBM at peak. If an XLA version's peak already
+            # excludes host space, peak - host falls BELOW that floor and
+            # we fall through to the raw value instead of underreporting.
+            dev_arg_floor = max(
+                0,
+                int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+                - int(getattr(ma, "host_argument_size_in_bytes", 0) or 0),
+            )
             if (
                 host_offload
                 and peak_bytes > 0
                 and 0 < host_bytes < peak_bytes
+                and peak_bytes - host_bytes >= dev_arg_floor
             ):
                 return (
                     (peak_bytes - host_bytes) / 1e9,

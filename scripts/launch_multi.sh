@@ -27,6 +27,7 @@ VIRTUAL_STAGES=2
 EXPERT_PARALLEL=1
 NUM_EXPERTS=0
 PARAM_DTYPE=""
+OFFLOAD_OPT_STATE=0
 IMAGE="tpu-llm-bench:latest"
 TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
@@ -53,6 +54,7 @@ while [ $# -gt 0 ]; do
     --expert-parallel) EXPERT_PARALLEL="$2"; shift 2 ;;
     --num-experts) NUM_EXPERTS="$2"; shift 2 ;;
     --param-dtype) PARAM_DTYPE="$2"; shift 2 ;;
+    --offload-opt-state) OFFLOAD_OPT_STATE=1; shift 1 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
     --job-name) JOB_NAME="$2"; shift 2 ;;
@@ -93,6 +95,7 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{EXPERT_PARALLEL}}|$EXPERT_PARALLEL|g" \
     -e "s|{{NUM_EXPERTS}}|$NUM_EXPERTS|g" \
     -e "s|{{PARAM_DTYPE}}|$PARAM_DTYPE|g" \
+    -e "s|{{OFFLOAD_OPT_STATE}}|$OFFLOAD_OPT_STATE|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \
     -e "s|{{TPU_ACCELERATOR}}|$TPU_ACCELERATOR|g" \
     -e "s|{{TPU_TOPOLOGY}}|$TPU_TOPOLOGY|g" \
