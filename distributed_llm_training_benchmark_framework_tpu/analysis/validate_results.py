@@ -20,7 +20,16 @@ module turns those prose envelopes into a suite step that fails loudly:
   ``sync_every == 1`` makes per-step times individually meaningful;
 - **memory**: measured peak (when the platform reports one) and the
   analytic estimate agree within a stated tolerance, and neither exceeds
-  the device's HBM capacity.
+  the device's HBM capacity;
+- **MFU floors** (round 5): published single-chip tier-A rows must not
+  silently regress — per-seq-len floors a few points under the measured
+  table (docs/PERFORMANCE.md §9/§12), applied only to the published-arm
+  geometry (tier A, ws=1, v5e, dense, no offload) so experimental configs
+  aren't blocked;
+- **offload CV allowance**: ZeRO-Offload rows run the optimizer on the
+  host CPU, whose load jitter legitimately exceeds the 10% device
+  envelope (PERFORMANCE.md §13) — they get their own, looser CV limit
+  instead of silently skipping the check.
 
 Exit code 0 = all envelopes hold; 1 = any violation (listed on stdout).
 """
@@ -52,6 +61,15 @@ EST_VS_MEASURED_TOL = 0.35
 # noise. A violation requires BOTH the relative band and this many GB of
 # absolute divergence. Tier-S smoke artifacts skip the check entirely.
 EST_VS_MEASURED_ABS_SLACK_GB = 0.25
+# Published-row MFU floors (% of v5e peak), a few points under the measured
+# single-chip tier-A table so real regressions trip while run-to-run noise
+# (±1.5% observed) does not: 2K 38.2%, 4K 33.6%, 8K 28.8%, 16K 24.6%
+# measured (docs/PERFORMANCE.md §9/§12).
+MFU_FLOORS_TIER_A = {2048: 36.0, 4096: 31.0, 8192: 26.0, 16384: 22.0}
+# Host-CPU AdamW step-time jitter under host load (PERFORMANCE.md §13
+# documents p50 varying 3.6-6.2 s run-to-run; within-run CV stays well
+# under this).
+OFFLOAD_STEP_CV_LIMIT_PCT = 25.0
 
 
 def _check(ok: bool, label: str, detail: str, failures: List[str]) -> None:
@@ -89,9 +107,37 @@ def validate_result(r: dict, name: str) -> List[str]:
 
     if r.get("sync_every", 1) == 1 and r.get("step_time_cv_pct", 0) > 0:
         cv = r["step_time_cv_pct"]
+        cv_limit = (
+            OFFLOAD_STEP_CV_LIMIT_PCT if r.get("offload_opt_state")
+            else STEP_CV_LIMIT_PCT
+        )
         _check(
-            cv < STEP_CV_LIMIT_PCT, name,
-            f"step-time cv {cv:.1f}% >= {STEP_CV_LIMIT_PCT}% envelope", f,
+            cv < cv_limit, name,
+            f"step-time cv {cv:.1f}% >= {cv_limit}% envelope"
+            + (" (offload allowance)" if r.get("offload_opt_state") else ""), f,
+        )
+
+    # MFU floors for the published-arm geometry only: tier A, single chip,
+    # v5e, flash attention, dense model, device-resident optimizer, and
+    # windowed timing (sync_every > 1 — the per-step block_until_ready
+    # diagnostic runs legitimately sit ~11 points lower). Any other
+    # geometry is exploratory and gets no floor.
+    floor = MFU_FLOORS_TIER_A.get(r.get("seq_len"))
+    if (
+        floor is not None
+        and r.get("tier") == "A"
+        and r.get("world_size") == 1
+        and "v5" in str(r.get("device_kind", ""))
+        and r.get("attention_impl") == "flash"
+        and r.get("sync_every", 1) > 1
+        and not r.get("offload_opt_state")
+        and r.get("n_experts", 0) == 0
+        and r.get("mfu_pct", 0) > 0
+    ):
+        _check(
+            r["mfu_pct"] >= floor, name,
+            f"mfu_pct={r['mfu_pct']:.1f}% below the {floor}% floor for "
+            f"seq_len={r['seq_len']} (published-row regression)", f,
         )
 
     est = r.get("est_hbm_gb", 0.0)

@@ -4,22 +4,44 @@ The reference lists "Mistral/Mixtral architectures" and MoE only as future
 work (reference ``README.md:1025``); here sparse expert layers are a
 first-class model family with their own mesh axis.
 
-TPU-native formulation (GShard/Switch): routing is expressed as two dense
-einsums against a one-hot *dispatch* tensor instead of gather/scatter —
-static shapes, MXU-friendly, and when the expert axis of the
-``(experts, capacity, d_model)`` buffers is sharded over the 'expert' mesh
-axis, GSPMD lowers the dispatch/combine einsums into the all-to-all exchange
-expert parallelism needs.
+Two dispatch formulations share the same routing math:
+
+1. **Explicit all-to-all** (``_moe_mlp_a2a``) — the expert-parallel path.
+   The batch is sharded over ``('data', 'expert')``
+   (``strategies.batch_partition_spec``), so each of the dp x ep members
+   routes its OWN tokens; inside a ``shard_map`` the dispatched
+   ``(experts, capacity, d_model)`` buffer is exchanged across the
+   'expert' axis with ``lax.all_to_all`` (one hop out, expert FFN on local
+   experts, one hop back). This is the DeepSpeed-MoE/Tutel schedule, and
+   the collective is *guaranteed* in the lowering because we emit it.
+
+2. **GSPMD einsum** (``_moe_mlp_einsum``) — routing as two dense einsums
+   against a one-hot dispatch tensor: static shapes, MXU-friendly, used on
+   meshes without a >1 'expert' axis and inside the pipeline schedules'
+   manual regions.
+
+Round-5 finding (the reason the explicit path exists): the SPMD
+partitioner does NOT lower the dispatch/combine einsums to all-to-all —
+AOT-compiling the einsum formulation for an 8-chip v5e topology shows 0
+``all-to-all`` ops; the partitioner picks all-gather/all-reduce
+strategies, which move the full token buffer across the expert axis. An
+earlier docstring claimed the opposite; ``tests/test_collective_lowering.py``
+now pins the all-to-all in the compiled HLO of the explicit path.
 
 Top-k routing with capacity: each token picks its top-k experts by router
 probability; each expert accepts at most C = ceil(capacity_factor * k * N / E)
 tokens (token order breaks ties); overflowing tokens are dropped for that
 expert (their combine weight is zero) — the standard capacity discipline that
-keeps every shape static under jit.
+keeps every shape static under jit. In the all-to-all path N and C are
+per-member quantities (capacity is provisioned per source shard), so drop
+decisions are shard-local; total capacity ep * C_local matches the global
+formulation's budget.
 
 The load-balance auxiliary loss is Switch-style: E * sum_e f_e * P_e, where
 f_e is the fraction of tokens dispatched to expert e (top-1 assignment) and
-P_e the mean router probability — minimized at uniform routing.
+P_e the mean router probability — minimized at uniform routing. The
+all-to-all path ``pmean``s f and P over the token-sharding axes so both
+formulations optimize the same global statistic.
 """
 
 from __future__ import annotations
@@ -28,6 +50,8 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 
 def capacity(n_tokens: int, n_experts: int, top_k: int, factor: float) -> int:
@@ -35,26 +59,13 @@ def capacity(n_tokens: int, n_experts: int, top_k: int, factor: float) -> int:
     return max(c, top_k)
 
 
-def moe_mlp(
-    config,
-    layer: dict,  # one layer's params: router, moe_w1/b1, moe_w2/b2
-    x: jax.Array,  # (B, S, D) compute dtype
-    dropout_key: Optional[jax.Array],
-    deterministic: bool,
-) -> Tuple[jax.Array, jax.Array]:
-    """-> (output (B,S,D), aux load-balance loss scalar fp32)."""
-    from .tinygpt import _dropout  # shared dropout primitive
-
-    c = config
-    B, S, D = x.shape
-    N = B * S
+def _route(c, xt: jax.Array, router: jax.Array, C: int):
+    """Shared routing math -> (dispatch (N,E,C), combine (N,E,C), probs,
+    expert_idx). fp32 router numerics (discipline as for softmax/LN)."""
+    N = xt.shape[0]
     E, K = c.n_experts, c.expert_top_k
-    C = capacity(N, E, K, c.capacity_factor)
-    xt = x.reshape(N, D)
-
-    # Router in fp32 (numerics discipline as for softmax/LN elsewhere).
     logits = jnp.einsum(
-        "nd,de->ne", xt, layer["router"].astype(jnp.float32),
+        "nd,de->ne", xt, router.astype(jnp.float32),
         preferred_element_type=jnp.float32,
     )
     probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
@@ -76,35 +87,186 @@ def moe_mlp(
 
     # dispatch (N, E, C): 1 where token n occupies slot c of expert e.
     disp = (
-        jax.nn.one_hot(expert_idx, E, dtype=x.dtype)[:, :, :, None]
-        * jax.nn.one_hot(jnp.where(keep, pos, C), C + 1, dtype=x.dtype)[:, :, None, :C]
+        jax.nn.one_hot(expert_idx, E, dtype=xt.dtype)[:, :, :, None]
+        * jax.nn.one_hot(jnp.where(keep, pos, C), C + 1, dtype=xt.dtype)[:, :, None, :C]
     )  # (N, K, E, C); pos>=C one-hots into the dropped C+1th slot, sliced off
     dispatch = jnp.sum(disp, axis=1)  # (N, E, C)
-    combine = jnp.sum(disp * gate_vals[:, :, None, None].astype(x.dtype), axis=1)
+    combine = jnp.sum(disp * gate_vals[:, :, None, None].astype(xt.dtype), axis=1)
+    return dispatch, combine, probs, expert_idx
 
-    # Expert compute on (E, C, D) buffers — batched over the expert axis,
-    # shardable on the 'expert' mesh axis.
-    xin = jnp.einsum("nd,nec->ecd", xt, dispatch, preferred_element_type=jnp.float32)
-    xin = xin.astype(c.compute_dtype)
+
+def _expert_ffn(c, xin: jax.Array, w1, b1, w2, b2) -> jax.Array:
+    """(E', C', D) -> (E', C', D) batched expert MLP, bf16 MXU / fp32 accum."""
     h = jnp.einsum(
-        "ecd,edf->ecf", xin, layer["moe_w1"].astype(c.compute_dtype),
+        "ecd,edf->ecf", xin, w1.astype(c.compute_dtype),
         preferred_element_type=jnp.float32,
-    ).astype(c.compute_dtype) + layer["moe_b1"].astype(c.compute_dtype)[:, None, :]
+    ).astype(c.compute_dtype) + b1.astype(c.compute_dtype)[:, None, :]
     h = jax.nn.gelu(h, approximate=False)
-    out_e = jnp.einsum(
-        "ecf,efd->ecd", h, layer["moe_w2"].astype(c.compute_dtype),
+    return jnp.einsum(
+        "ecf,efd->ecd", h, w2.astype(c.compute_dtype),
         preferred_element_type=jnp.float32,
-    ).astype(c.compute_dtype) + layer["moe_b2"].astype(c.compute_dtype)[:, None, :]
+    ).astype(c.compute_dtype) + b2.astype(c.compute_dtype)[:, None, :]
 
+
+def _aux_stats(probs: jax.Array, expert_idx: jax.Array, E: int):
+    """Switch load-balance statistics on the top-1 assignment -> (f, p)."""
+    top1 = jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32)
+    f = jnp.mean(top1, axis=0)  # fraction of tokens per expert
+    p = jnp.mean(probs, axis=0)  # mean router prob per expert
+    return f, p
+
+
+def _moe_mlp_einsum(c, layer, x, dropout_key, deterministic):
+    """GSPMD formulation: dense einsums, sharding left to the partitioner."""
+    from .tinygpt import _dropout
+
+    B, S, D = x.shape
+    N = B * S
+    E = c.n_experts
+    C = capacity(N, E, c.expert_top_k, c.capacity_factor)
+    xt = x.reshape(N, D)
+
+    dispatch, combine, probs, expert_idx = _route(c, xt, layer["router"], C)
+
+    # Expert compute on (E, C, D) buffers — batched over the expert axis.
+    xin = jnp.einsum("nd,nec->ecd", xt, dispatch, preferred_element_type=jnp.float32)
+    out_e = _expert_ffn(
+        c, xin.astype(c.compute_dtype),
+        layer["moe_w1"], layer["moe_b1"], layer["moe_w2"], layer["moe_b2"],
+    )
     y = jnp.einsum(
         "ecd,nec->nd", out_e, combine, preferred_element_type=jnp.float32
     ).astype(x.dtype)
     y = _dropout(y, c.dropout, dropout_key, deterministic)
 
-    # Switch load-balance loss on the top-1 assignment.
-    top1 = jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32)
-    f = jnp.mean(top1, axis=0)           # fraction of tokens per expert
-    p = jnp.mean(probs, axis=0)          # mean router prob per expert
+    f, p = _aux_stats(probs, expert_idx, E)
     aux = E * jnp.sum(f * p)
-
     return y.reshape(B, S, D), aux
+
+
+def _moe_mlp_a2a(c, layer, x, dropout_key, deterministic, mesh, ep, dp):
+    """Expert-parallel formulation: explicit all-to-all inside shard_map.
+
+    Token layout: batch dim sharded over ('data', 'expert') — every member
+    routes B*S/(dp*ep) tokens. Expert layout: weight tensors sharded over
+    'expert' on their leading experts axis (strategies._EP_RULES), E/ep
+    local experts per member. Two ``lax.all_to_all`` hops exchange the
+    per-source-capacity buffers; the expert FFN runs on (E/ep, ep*C, D).
+    """
+    from .tinygpt import _dropout
+
+    B, S, D = x.shape
+    E, K = c.n_experts, c.expert_top_k
+    E_loc = E // ep
+    batch_ax = ("data", "expert") if dp > 1 else ("expert",)
+    xspec = P(batch_ax, None, None)
+    have_key = dropout_key is not None
+    key = dropout_key if have_key else jax.random.key(0)
+
+    def body(x_loc, router, w1, b1, w2, b2, key):
+        Bl, S_, D_ = x_loc.shape
+        N = Bl * S_
+        C = capacity(N, E, K, c.capacity_factor)
+        xt = x_loc.reshape(N, D_)
+
+        dispatch, combine, probs, expert_idx = _route(c, xt, router, C)
+
+        xin = jnp.einsum(
+            "nd,nec->ecd", xt, dispatch, preferred_element_type=jnp.float32
+        ).astype(c.compute_dtype)  # (E, C, D)
+
+        # Hop out: split the experts axis into ep destination groups; after
+        # the exchange dim 0 indexes the SOURCE member, so member m holds
+        # its E_loc experts' slices from every source.
+        xin = xin.reshape(ep, E_loc, C, D_)
+        xin = lax.all_to_all(xin, "expert", split_axis=0, concat_axis=0)
+        xe = xin.transpose(1, 0, 2, 3).reshape(E_loc, ep * C, D_)
+
+        out = _expert_ffn(c, xe, w1, b1, w2, b2)  # (E_loc, ep*C, D)
+
+        # Hop back: regroup by source and return each member its slots.
+        out = out.reshape(E_loc, ep, C, D_).transpose(1, 0, 2, 3)
+        out = lax.all_to_all(out, "expert", split_axis=0, concat_axis=0)
+        out_full = out.reshape(E, C, D_)
+
+        y = jnp.einsum(
+            "ecd,nec->nd", out_full, combine, preferred_element_type=jnp.float32
+        ).astype(x_loc.dtype)
+        if have_key:
+            # Distinct dropout stream per token shard (same discipline as
+            # the pipeline schedules' per-shard fold, tinygpt.py).
+            member = lax.axis_index("expert") + (
+                ep * lax.axis_index("data") if dp > 1 else 0
+            )
+            y = _dropout(
+                y, c.dropout, jax.random.fold_in(key, member), deterministic
+            )
+
+        f, p = _aux_stats(probs, expert_idx, E)
+        # Both statistics are means over the GLOBAL token set in the einsum
+        # formulation; average over the token-sharding axes to match.
+        f = lax.pmean(f, batch_ax)
+        p = lax.pmean(p, batch_ax)
+        aux = E * jnp.sum(f * p)
+        return y.reshape(Bl, S_, D_), aux
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            xspec,
+            P(None, None),            # router replicated (tiny; all tokens need all scores)
+            P("expert", None, None),  # moe_w1 (E, D, F)
+            P("expert", None),        # moe_b1 (E, F)
+            P("expert", None, None),  # moe_w2 (E, F, D)
+            P("expert", None),        # moe_b2 (E, D)
+            P(),
+        ),
+        out_specs=(xspec, P()),
+    )
+    return fn(
+        x, layer["router"], layer["moe_w1"], layer["moe_b1"],
+        layer["moe_w2"], layer["moe_b2"], key,
+    )
+
+
+def moe_mlp(
+    config,
+    layer: dict,  # one layer's params: router, moe_w1/b1, moe_w2/b2
+    x: jax.Array,  # (B, S, D) compute dtype
+    dropout_key: Optional[jax.Array],
+    deterministic: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """-> (output (B,S,D), aux load-balance loss scalar fp32).
+
+    Picks the dispatch formulation per ``config.moe_dispatch`` (module
+    docstring): the explicit all-to-all path needs a mesh in scope with a
+    >1 'expert' axis, divisible geometry, and no manual/sequence/tensor/
+    pipeline axes in play; anything else falls back to the GSPMD einsums.
+    """
+    c = config
+    B, S, D = x.shape
+    mesh = None
+    if c.moe_dispatch != "einsum" and c.seq_manual_axis is None:
+        m = jax.sharding.get_abstract_mesh()
+        if m is not None and "expert" in getattr(m, "axis_names", ()):
+            mesh = m
+    ep = mesh.shape.get("expert", 1) if mesh is not None else 1
+    dp = mesh.shape.get("data", 1) if mesh is not None else 1
+    geometry_ok = (
+        ep > 1
+        and c.n_experts % ep == 0
+        and B % (dp * ep) == 0
+        and mesh.shape.get("model", 1) == 1
+        and mesh.shape.get("seq", 1) == 1
+        and mesh.shape.get("pipe", 1) == 1
+    )
+    if c.moe_dispatch == "alltoall" and not geometry_ok:
+        raise ValueError(
+            "moe_dispatch='alltoall' needs an in-scope mesh with a >1 "
+            "'expert' axis, n_experts % ep == 0, batch % (dp*ep) == 0, and "
+            f"no model/seq/pipe axes > 1 (got mesh={mesh}, B={B})"
+        )
+    if geometry_ok:
+        return _moe_mlp_a2a(c, layer, x, dropout_key, deterministic, mesh, ep, dp)
+    return _moe_mlp_einsum(c, layer, x, dropout_key, deterministic)

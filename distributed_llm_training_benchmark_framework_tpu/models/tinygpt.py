@@ -114,6 +114,13 @@ class TinyGPTConfig:
     expert_top_k: int = 2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # Expert-parallel dispatch: 'auto' uses the explicit all-to-all
+    # shard_map path whenever an 'expert' mesh axis (>1) is in scope and
+    # the geometry allows it, falling back to the GSPMD einsum formulation
+    # (models.moe module docstring — the partitioner does NOT lower the
+    # dispatch einsums to all-to-all on its own). 'alltoall' forces the
+    # explicit path (raises if the geometry can't), 'einsum' forces GSPMD.
+    moe_dispatch: str = "auto"
 
     @property
     def head_dim(self) -> int:

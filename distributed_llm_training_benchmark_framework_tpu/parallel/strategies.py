@@ -618,9 +618,18 @@ def opt_state_partition_specs(
 
 
 def batch_partition_spec(mesh: Mesh) -> P:
-    """Global batch (batch, seq): batch dim sharded on 'data', sequence dim on
-    'seq' when a sequence-parallel axis exists (ring attention consumes it)."""
-    batch_axis = "data" if mesh.shape.get("data", 1) > 1 else None
+    """Global batch (batch, seq): batch dim sharded on 'data' — AND on
+    'expert' when an expert-parallel axis exists — sequence dim on 'seq'
+    when a sequence-parallel axis exists (ring attention consumes it).
+
+    Expert parallelism rides the batch dim (DeepSpeed-MoE style): each of
+    the dp x ep device groups processes a DISTINCT batch shard, and the MoE
+    layer exchanges tokens across 'expert' with an explicit all-to-all
+    (models.moe). The round-4 layout kept the batch replicated over
+    'expert', which silently duplicated all non-expert compute ep times —
+    half the machine re-deriving the same activations at ep=2."""
+    axes = tuple(ax for ax in ("data", "expert") if mesh.shape.get(ax, 1) > 1)
+    batch_axis = axes if axes else None
     seq_axis = "seq" if mesh.shape.get("seq", 1) > 1 else None
     if seq_axis is None:
         return P(batch_axis) if batch_axis else P()

@@ -94,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dropout", type=float, default=None,
                    help="Override model dropout rate (default: tier's 0.1, "
                         "parity with the reference model)")
+    p.add_argument("--causal", action="store_true",
+                   help="Causal (autoregressive) attention masking. Default "
+                        "off for reference parity (train_harness.py:127 "
+                        "applies no mask); on causal rings this auto-enables "
+                        "the zigzag load-balanced layout")
     p.add_argument("--flash-block-q", type=int, default=None,
                    help="Flash-attention q tile size (default: kernel-tuned)")
     p.add_argument("--flash-block-k", type=int, default=None,
@@ -256,6 +261,7 @@ def main(argv=None) -> int:
             seed=args.seed,
             attention_impl=args.attention,
             dropout=args.dropout,
+            causal=args.causal,
             flash_block_q=args.flash_block_q,
             flash_block_k=args.flash_block_k,
             flash_block_k_bwd=args.flash_block_k_bwd,

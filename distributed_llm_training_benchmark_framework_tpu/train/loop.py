@@ -77,6 +77,7 @@ def _run_benchmark_impl(
     seed: int = 42,
     attention_impl: str = "reference",
     dropout: Optional[float] = None,
+    causal: bool = False,
     flash_block_q: Optional[int] = None,
     flash_block_k: Optional[int] = None,
     flash_block_k_bwd: Optional[int] = None,
@@ -141,6 +142,11 @@ def _run_benchmark_impl(
             )
 
     overrides = {} if dropout is None else {"dropout": dropout}
+    if causal:
+        # Causal masking is an explicit opt-in (reference parity keeps it
+        # off, train_harness.py:127); causal rings auto-enable the zigzag
+        # load-balanced layout (ops/ring_attention.py).
+        overrides["causal"] = True
     if n_experts > 0:
         overrides["n_experts"] = n_experts
     if flash_block_q is not None:
@@ -170,7 +176,10 @@ def _run_benchmark_impl(
     # Data-parallel width sets the global microbatch; tp/sp groups share
     # replicas of each example (matching how the reference's world_size
     # multiplies per-device batch for pure DP, reference train_harness.py:403).
-    global_micro = per_device_batch * dp
+    # Expert-parallel members hold distinct batch shards (the batch dim is
+    # sharded over ('data', 'expert') — strategies.batch_partition_spec), so
+    # the global microbatch scales with dp * ep.
+    global_micro = per_device_batch * dp * ep
 
     # Fail fast on arms that cannot fit (e.g. tier B replicated on a 16 GiB
     # v5e chip) — refuse with a breakdown instead of an allocator OOM mid-run.
@@ -388,6 +397,7 @@ def _run_benchmark_impl(
         remat_policy=state.model_config.remat,
         param_dtype=strategy.param_dtype,
         offload_opt_state=strategy.offload_opt_state,
+        causal=model_config.causal,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

@@ -102,11 +102,18 @@ def forward_flops_per_token(config) -> float:
         mlp = 2 * config.expert_top_k * (8 * D * D) + 2 * D * config.n_experts
     else:
         mlp = 16 * D * D
+    # Causal masking halves the score-matrix work: the flash/ring kernels
+    # skip fully-masked tiles (ops/flash_attention.py `live`), so charging
+    # full S would overstate MFU on --causal runs by up to ~1.5x at 16K.
+    # The exact executed fraction is (S + block)/2S; the standard 1/2
+    # accounting (PaLM-style MFU) is used so causal and non-causal rows
+    # stay comparable across block sizes.
+    attn_tokens = S / 2 if getattr(config, "causal", False) else S
     per_layer = (
         6 * D * D  # QKV projection
         + 2 * D * D  # attention output projection
         + mlp
-        + 4 * S * D  # QK^T and probs@V
+        + 4 * attn_tokens * D  # QK^T and probs@V
     )
     return float(L * per_layer + 2 * D * V)
 

@@ -212,6 +212,10 @@ class BenchmarkResult:
     # run identity for arms sharing (strategy, tier, seq) geometry.
     param_dtype: str = "f32"
     offload_opt_state: bool = False
+    # Causal (autoregressive) masking — False is reference parity
+    # (train_harness.py:127 applies no mask); True halves attention FLOPs
+    # and, on causal rings, turns on the zigzag load-balanced layout.
+    causal: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -254,6 +258,7 @@ def compute_result(
     remat_policy: str = "none",
     param_dtype: str = "f32",
     offload_opt_state: bool = False,
+    causal: bool = False,
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
@@ -261,11 +266,14 @@ def compute_result(
     # sequences per *data-parallel replica* (our accumulation is real, and
     # tensor/sequence-parallel groups jointly compute one example rather than
     # multiplying throughput; see module docstring). With tp=sp=1 this is the
-    # reference's formula (train_harness.py:403).
+    # reference's formula (train_harness.py:403). Expert-parallel groups DO
+    # multiply throughput: the batch is sharded over ('data', 'expert')
+    # (strategies.batch_partition_spec), so each expert-axis member consumes
+    # its own per_device_batch sequences.
     dp = world_size // (
         tensor_parallel * sequence_parallel * pipeline_parallel * expert_parallel
     )
-    tokens_per_step = per_device_batch * grad_accum * seq_len * dp
+    tokens_per_step = per_device_batch * grad_accum * seq_len * dp * expert_parallel
     tps = tokens_per_step / mean_step if mean_step > 0 else 0.0
     bytes_per_step = per_device_batch * grad_accum * seq_len * 4
     h2d = (bytes_per_step / mean_step) / 1e9 if mean_step > 0 else 0.0
@@ -331,6 +339,7 @@ def compute_result(
         remat_policy=remat_policy,
         param_dtype=param_dtype,
         offload_opt_state=offload_opt_state,
+        causal=causal,
     )
 
 

@@ -28,6 +28,7 @@ EXPERT_PARALLEL=1
 NUM_EXPERTS=0
 PARAM_DTYPE=""
 OFFLOAD_OPT_STATE=0
+CAUSAL=0
 IMAGE="tpu-llm-bench:latest"
 TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
@@ -55,6 +56,7 @@ while [ $# -gt 0 ]; do
     --num-experts) NUM_EXPERTS="$2"; shift 2 ;;
     --param-dtype) PARAM_DTYPE="$2"; shift 2 ;;
     --offload-opt-state) OFFLOAD_OPT_STATE=1; shift 1 ;;
+    --causal) CAUSAL=1; shift 1 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
     --job-name) JOB_NAME="$2"; shift 2 ;;
@@ -96,6 +98,7 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{NUM_EXPERTS}}|$NUM_EXPERTS|g" \
     -e "s|{{PARAM_DTYPE}}|$PARAM_DTYPE|g" \
     -e "s|{{OFFLOAD_OPT_STATE}}|$OFFLOAD_OPT_STATE|g" \
+    -e "s|{{CAUSAL}}|$CAUSAL|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \
     -e "s|{{TPU_ACCELERATOR}}|$TPU_ACCELERATOR|g" \
     -e "s|{{TPU_TOPOLOGY}}|$TPU_TOPOLOGY|g" \

@@ -184,6 +184,7 @@ pp2-gpipe|ddp|--pipeline-parallel 2 --pipeline-schedule gpipe|--pipeline-paralle
 pp2-1f1b|ddp|--pipeline-parallel 2 --pipeline-schedule 1f1b|--pipeline-parallel 2 --pipeline-schedule 1f1b
 pp2-interleaved|ddp|--pipeline-parallel 2 --pipeline-schedule interleaved --virtual-stages $VIRT|--pipeline-parallel 2 --pipeline-schedule interleaved --virtual-stages $VIRT
 sp2-ring|zero2|--sequence-parallel 2 --attention ring|--sequence-parallel 2 --attention ring
+sp2-ring-causal|zero2|--sequence-parallel 2 --attention ring --causal|--sequence-parallel 2 --attention ring --causal
 sp2-ulysses|zero2|--sequence-parallel 2 --attention ulysses|--sequence-parallel 2 --attention ulysses
 moe-ep2|zero2|--num-experts 4 --expert-parallel 2|--num-experts 4 --expert-parallel 2
 "

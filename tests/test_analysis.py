@@ -198,6 +198,51 @@ def test_validate_results_memory_envelopes(tmp_path):
     assert any("exceeds" in f for f in failures)
 
 
+def test_validate_results_offload_cv_allowance(tmp_path):
+    """Offload rows get the looser host-jitter CV envelope — 25% trips the
+    default 10% limit but not the offload allowance; 30% trips both."""
+    write_results(tmp_path, [
+        result(sync_every=1, step_time_cv_pct=18.0, offload_opt_state=True),
+    ])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("cv" in f for f in failures)
+    write_results(tmp_path, [
+        result(sync_every=1, step_time_cv_pct=30.0, offload_opt_state=True),
+    ])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert any("offload allowance" in f for f in failures)
+
+
+def test_validate_results_mfu_floor(tmp_path):
+    """A published-geometry row whose MFU regressed below the floor fails;
+    the same MFU on a non-published geometry (reference attention) passes."""
+    degraded = result(
+        strategy="zero2", ws=1, seq=4096, attention_impl="flash",
+        device_kind="TPU v5 lite", mfu_pct=24.0, sync_every=10,
+    )
+    write_results(tmp_path, [degraded])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert any("below the 31.0% floor" in f for f in failures)
+    # Same number under reference attention: exploratory, no floor.
+    write_results(tmp_path, [dict(degraded, attention_impl="reference")])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("floor" in f for f in failures)
+    # Healthy published row passes.
+    write_results(tmp_path, [dict(degraded, mfu_pct=33.6)])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("floor" in f for f in failures)
+
+
+def test_validate_results_published_artifacts_pass():
+    """The committed example_output must satisfy its own envelopes —
+    including the new MFU floors against the published rows."""
+    root = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                        "results", "example_output")
+    failures, n = vr.collect(root, None)
+    assert n > 0
+    assert failures == [], failures
+
+
 def test_validate_results_marker_contract(tmp_path):
     write_results(tmp_path, [result()])
     good = tmp_path / "good.log"

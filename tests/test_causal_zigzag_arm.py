@@ -1,0 +1,82 @@
+"""Causal + zigzag reachable end-to-end: model -> step -> harness surface.
+
+Round-4 verdict finding: ``TinyGPTConfig.causal`` was plumbed to every
+attention impl but unreachable from the operator's seat (no CLI flag, no
+env var, no dryrun arm) — the zigzag load-balanced ring layout (auto-on for
+causal rings, ops/ring_attention.py) only ever ran inside its own op tests.
+These tests pin the round-5 fix at every level above the op:
+
+1. the driver dryrun roster runs a causal sp=4 ring arm whose loss must
+   match a replicated causal baseline (zigzag auto-engages: n=4 > 1, even
+   local shard, no explicit blocks);
+2. the harness CLI accepts ``--causal`` and stamps ``"causal": true`` into
+   the emitted result JSON (so parse_metrics keys run identity on it);
+3. the container env contract maps CAUSAL=1 -> ``--causal`` (hermetic grep
+   of docker/entrypoint.sh, same style as the entrypoint contract tests).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_causal_zigzag_dryrun_arm_loss_parity():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [
+            sys.executable, "-u", os.path.join(REPO, "__graft_entry__.py"),
+            "8", "causal",
+        ],
+        capture_output=True, text=True, env=env, timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    m = re.search(
+        r"zero2 causal sp=4 \(zigzag ring\): OK, loss=([\d.]+), "
+        r"parity vs replicated rel-delta=([\d.e+-]+)",
+        proc.stdout,
+    )
+    assert m, proc.stdout[-4000:]
+    assert float(m.group(1)) > 0
+    assert float(m.group(2)) < 2e-2
+
+
+def test_harness_causal_flag_reaches_result_json(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [
+            sys.executable, "-u", "-m",
+            "distributed_llm_training_benchmark_framework_tpu.train.harness",
+            "--strategy", "zero2", "--world-size", "4", "--tier", "S",
+            "--seq-len", "128", "--steps", "3", "--warmup-steps", "1",
+            "--per-device-batch", "2", "--grad-accum", "1",
+            "--sequence-parallel", "4", "--attention", "ring", "--causal",
+            "--results-dir", str(tmp_path),
+        ],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-4000:]
+    result = json.loads(
+        (tmp_path / "result_zero2_ws4_seq128_tierS.json").read_text()
+    )
+    assert result["causal"] is True
+    assert result["attention_impl"] == "ring"
+    assert result["sequence_parallel"] == 4
+    assert result["mean_loss"] > 0
+
+
+def test_entrypoint_maps_causal_env_to_flag():
+    src = open(os.path.join(REPO, "docker", "entrypoint.sh")).read()
+    assert 'export CAUSAL="${CAUSAL:-0}"' in src
+    assert re.search(r'CAUSAL\}"\s*=\s*"1"\s*\]; then\s*\n\s*ARGS="\$\{ARGS\} --causal"', src)

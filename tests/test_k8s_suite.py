@@ -78,7 +78,11 @@ if "logs" in argv:
     elif comp.startswith("pp2-"):
         result.update(pipeline_parallel=2, pipeline_schedule=comp[4:])
     elif comp.startswith("sp2-"):
-        result.update(sequence_parallel=2, attention_impl=comp[4:])
+        att = comp[4:]
+        if att.endswith("-causal"):
+            att = att[:-len("-causal")]
+            result["causal"] = True
+        result.update(sequence_parallel=2, attention_impl=att)
     elif comp == "moe-ep2":
         result.update(expert_parallel=2, n_experts=4)
     print("boot log line")
@@ -193,6 +197,7 @@ COMP_JOBS = {
     "tpu-bench-ddp-ws4-pp2-1f1b",
     "tpu-bench-ddp-ws4-pp2-interleaved",
     "tpu-bench-zero2-ws4-sp2-ring",
+    "tpu-bench-zero2-ws4-sp2-ring-causal",
     "tpu-bench-zero2-ws4-sp2-ulysses",
     "tpu-bench-zero2-ws4-moe-ep2",
 }
@@ -227,10 +232,10 @@ def roster_run(tmp_path_factory):
     return proc, tmp, results
 
 
-def test_roster_exits_zero_with_seven_arms(roster_run):
+def test_roster_exits_zero_with_eight_arms(roster_run):
     proc, _, _ = roster_run
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
-    assert "7 passed, 0 failed" in proc.stdout
+    assert "8 passed, 0 failed" in proc.stdout
 
 
 def test_roster_job_names_and_manifest_env(roster_run):
@@ -251,7 +256,11 @@ def test_roster_job_names_and_manifest_env(roster_run):
     ring = (tmp / "manifest_tpu-bench-zero2-ws4-sp2-ring.yaml").read_text()
     assert 'name: SEQUENCE_PARALLEL\n              value: "2"' in ring
     assert 'name: ATTENTION\n              value: "ring"' in ring
+    assert 'name: CAUSAL\n              value: "0"' in ring
+    zz = (tmp / "manifest_tpu-bench-zero2-ws4-sp2-ring-causal.yaml").read_text()
+    assert 'name: CAUSAL\n              value: "1"' in zz
     moe = (tmp / "manifest_tpu-bench-zero2-ws4-moe-ep2.yaml").read_text()
+    assert 'name: OFFLOAD_OPT_STATE\n              value: "0"' in moe
     assert 'name: NUM_EXPERTS\n              value: "4"' in moe
     assert 'name: EXPERT_PARALLEL\n              value: "2"' in moe
     for f in manifests:
@@ -267,6 +276,7 @@ def test_roster_rows_survive_dedup(roster_run):
     import pandas as pd
 
     df = pd.read_csv(results / "summary" / "metrics.csv")
-    # 7 composition runs, all (strategy, ws)-colliding pairs kept distinct
-    # by the composition axes in the identity key.
-    assert len(df) == 7, df
+    # 8 composition runs, all (strategy, ws)-colliding pairs kept distinct
+    # by the composition axes in the identity key (sp2-ring vs
+    # sp2-ring-causal collide on everything except the causal column).
+    assert len(df) == 8, df
