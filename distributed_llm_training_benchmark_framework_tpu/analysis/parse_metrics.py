@@ -63,7 +63,7 @@ def load_results(results_dir: str) -> pd.DataFrame:
             "tensor_parallel", "sequence_parallel", "pipeline_parallel",
             "pipeline_schedule", "virtual_stages", "expert_parallel",
             "n_experts", "remat_policy", "param_dtype", "offload_opt_state",
-            "causal",
+            "offload_delayed_update", "causal", "ring_zigzag",
         ) if c in df.columns
     ]
     df = df.drop_duplicates(subset=key, keep="first")
@@ -83,7 +83,8 @@ def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
             "tier", "per_device_batch", "grad_accum", "attention_impl",
             "tensor_parallel", "sequence_parallel", "pipeline_parallel",
             "pipeline_schedule", "virtual_stages", "expert_parallel",
-            "n_experts", "param_dtype", "offload_opt_state", "causal",
+            "n_experts", "param_dtype", "offload_opt_state",
+            "offload_delayed_update", "causal", "ring_zigzag",
         )
         if c in df.columns
     ]

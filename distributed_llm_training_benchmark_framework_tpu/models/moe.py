@@ -92,7 +92,8 @@ def _route(c, xt: jax.Array, router: jax.Array, C: int):
     )  # (N, K, E, C); pos>=C one-hots into the dropped C+1th slot, sliced off
     dispatch = jnp.sum(disp, axis=1)  # (N, E, C)
     combine = jnp.sum(disp * gate_vals[:, :, None, None].astype(xt.dtype), axis=1)
-    return dispatch, combine, probs, expert_idx
+    drop_frac = jnp.mean(1.0 - keep.astype(jnp.float32))
+    return dispatch, combine, probs, expert_idx, drop_frac
 
 
 def _expert_ffn(c, xin: jax.Array, w1, b1, w2, b2) -> jax.Array:
@@ -126,7 +127,9 @@ def _moe_mlp_einsum(c, layer, x, dropout_key, deterministic):
     C = capacity(N, E, c.expert_top_k, c.capacity_factor)
     xt = x.reshape(N, D)
 
-    dispatch, combine, probs, expert_idx = _route(c, xt, layer["router"], C)
+    dispatch, combine, probs, expert_idx, drop_frac = _route(
+        c, xt, layer["router"], C
+    )
 
     # Expert compute on (E, C, D) buffers — batched over the expert axis.
     xin = jnp.einsum("nd,nec->ecd", xt, dispatch, preferred_element_type=jnp.float32)
@@ -139,6 +142,8 @@ def _moe_mlp_einsum(c, layer, x, dropout_key, deterministic):
     ).astype(x.dtype)
     y = _dropout(y, c.dropout, dropout_key, deterministic)
 
+    if c.moe_aux_mode == "overflow":
+        return y.reshape(B, S, D), drop_frac
     f, p = _aux_stats(probs, expert_idx, E)
     aux = E * jnp.sum(f * p)
     return y.reshape(B, S, D), aux
@@ -169,7 +174,9 @@ def _moe_mlp_a2a(c, layer, x, dropout_key, deterministic, mesh, ep, dp):
         C = capacity(N, E, K, c.capacity_factor)
         xt = x_loc.reshape(N, D_)
 
-        dispatch, combine, probs, expert_idx = _route(c, xt, router, C)
+        dispatch, combine, probs, expert_idx, drop_frac = _route(
+            c, xt, router, C
+        )
 
         xin = jnp.einsum(
             "nd,nec->ecd", xt, dispatch, preferred_element_type=jnp.float32
@@ -202,6 +209,8 @@ def _moe_mlp_a2a(c, layer, x, dropout_key, deterministic, mesh, ep, dp):
                 y, c.dropout, jax.random.fold_in(key, member), deterministic
             )
 
+        if c.moe_aux_mode == "overflow":
+            return y.reshape(Bl, S_, D_), lax.pmean(drop_frac, batch_ax)
         f, p = _aux_stats(probs, expert_idx, E)
         # Both statistics are means over the GLOBAL token set in the einsum
         # formulation; average over the token-sharding axes to match.

@@ -114,6 +114,17 @@ class TinyGPTConfig:
     expert_top_k: int = 2
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
+    # Zigzag causal load balancing on ring attention: None = auto (on for
+    # causal rings with even local shards — ops/ring_attention.py), True =
+    # force (errors when the geometry can't), False = force the contiguous
+    # layout. The off switch exists for the scaling-day A/B microbench
+    # (zigzag's benefit is multi-chip wall-clock, unmeasurable single-chip).
+    ring_zigzag: Optional[bool] = None
+    # Aux channel content: 'switch' (the load-balance loss term, default)
+    # or 'overflow' (fraction of (token, choice) assignments dropped by the
+    # capacity limit) — the latter powers the moe_overflow_fraction
+    # diagnostic without widening the aux carry through every schedule.
+    moe_aux_mode: str = "switch"
     # Expert-parallel dispatch: 'auto' uses the explicit all-to-all
     # shard_map path whenever an 'expert' mesh axis (>1) is in scope and
     # the geometry allows it, falling back to the GSPMD einsum formulation
@@ -295,6 +306,7 @@ def _attention(
                 dropout_seed=seed,
                 block_q=config.flash_block_q, block_k=config.flash_block_k,
                 block_k_bwd=config.flash_block_k_bwd,
+                zigzag=config.ring_zigzag,
             )
         if config.attention_impl == "ulysses":
             from ..ops.ulysses_attention import ulysses_attention_sharded
@@ -333,6 +345,7 @@ def _attention(
             dropout_seed=seed,
             block_q=config.flash_block_q, block_k=config.flash_block_k,
             block_k_bwd=config.flash_block_k_bwd,
+            zigzag=config.ring_zigzag,
         )
     if config.attention_impl == "ulysses":
         from ..ops.ulysses_attention import ulysses_attention
@@ -581,6 +594,26 @@ def forward(
             # Mean aux per layer, Switch-style coefficient.
             loss = loss + c.router_aux_coef * aux / c.n_layer
     return logits, loss
+
+
+def moe_overflow_fraction(
+    config: TinyGPTConfig, params: Params, idx: jax.Array
+) -> jax.Array:
+    """Diagnostic: mean fraction of (token, choice) expert assignments
+    dropped by the capacity limit, averaged over layers, on one batch.
+
+    Powers the published MoE row's ``expert_overflow_pct`` (the analogue
+    of DeepSpeed's dropped-token logging; the reference has no MoE at
+    all). Runs a dropout-free forward with the aux channel switched to
+    overflow accounting (``moe_aux_mode='overflow'``) — zero impact on the
+    training step itself.
+    """
+    import dataclasses
+
+    c = dataclasses.replace(config, moe_aux_mode="overflow", dropout=0.0)
+    x = embed(c, params, idx, None, True)
+    _, aux = apply_blocks(c, params["blocks"], x, None, True)
+    return aux / c.n_layer
 
 
 def _cross_entropy_parts(

@@ -736,6 +736,7 @@ def ring_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     block_k_bwd: Optional[int] = None,
+    zigzag: Optional[bool] = None,
 ) -> jax.Array:
     """Shard the sequence over ``axis_name`` and run the ring. Falls back to
     flash attention when no such mesh axis is in scope (so models configured
@@ -776,6 +777,7 @@ def ring_attention(
             dropout_rate=dropout_rate, dropout_seed=seed_s,
             batch_axis=batch_ax, heads_axis=model_ax,
             block_q=block_q, block_k=block_k, block_k_bwd=block_k_bwd,
+            zigzag=zigzag,
         )
 
     fn = jax.shard_map(

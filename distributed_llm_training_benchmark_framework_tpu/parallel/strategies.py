@@ -90,6 +90,15 @@ class StrategyConfig:
     # HBM: Adam runs in full fp32 against master weights. Costs per-step
     # PCIe traffic (~2 x bf16-param bytes); see docs/PERFORMANCE.md.
     offload_opt_state: bool = False
+    # Delayed parameter update for the offload arm (DeepSpeed's
+    # delayed_param_update analogue, opt-in): the host consumes the
+    # PREVIOUS step's gradients (parked in pinned host memory) while the
+    # device runs the CURRENT step's forward/backward — the two have no
+    # data dependency inside one program, so XLA's scheduler overlaps the
+    # multi-second host Adam with device compute instead of serializing
+    # behind it. Params are one step stale (training-semantics change —
+    # hence opt-in); step 0 performs no update (its grads become step 1's).
+    offload_delayed_update: bool = False
 
     def describe(self) -> str:
         bits = [
@@ -103,6 +112,8 @@ class StrategyConfig:
             bits.append(f"param_dtype={self.param_dtype}")
         if self.offload_opt_state:
             bits.append("opt_offload=pinned_host")
+        if self.offload_delayed_update:
+            bits.append("delayed_update")
         return f"{self.name}: " + ", ".join(bits)
 
 
@@ -344,8 +355,8 @@ def from_deepspeed_config(raw: Dict[str, Any], strategy_name: str) -> StrategyCo
     )
 
 
-def _base_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
-    """The plain AdamW chain (+ optional clip + warmup) for one arm."""
+def _adamw_only(strategy: StrategyConfig) -> optax.GradientTransformation:
+    """AdamW with the arm's warmup schedule, WITHOUT the clip stage."""
     if strategy.warmup_steps > 0:
         lr = optax.linear_schedule(
             init_value=0.0,
@@ -354,13 +365,18 @@ def _base_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
         )
     else:
         lr = strategy.learning_rate
-    tx = optax.adamw(
+    return optax.adamw(
         learning_rate=lr,
         b1=strategy.betas[0],
         b2=strategy.betas[1],
         eps=strategy.eps,
         weight_decay=strategy.weight_decay,
     )
+
+
+def _base_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
+    """The plain AdamW chain (+ optional clip + warmup) for one arm."""
+    tx = _adamw_only(strategy)
     if strategy.grad_clip is not None:
         tx = optax.chain(optax.clip_by_global_norm(float(strategy.grad_clip)), tx)
     return tx
@@ -393,7 +409,19 @@ def make_optimizer(strategy: StrategyConfig) -> optax.GradientTransformation:
         master = jax.tree.map(
             lambda p: p.astype(jnp.float32), params
         )
-        return (master, tx.init(master))
+        state = (master, tx.init(master))
+        if strategy.offload_delayed_update:
+            # Delayed update: the state additionally parks last step's
+            # (pre-scaled) gradients in pinned host memory, plus their clip
+            # scale. Step 0 consumes these zeros: with warmup (the ZeRO
+            # arms' schedule starts at lr=0) that is an exact no-op on the
+            # masters; without warmup it applies one weight-decay-only
+            # micro-step (documented DPU semantics).
+            pending = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, p.dtype), params
+            )
+            state = state + ((pending, jnp.zeros((), jnp.float32)),)
+        return state
 
     def update(grads, state, params=None):
         raise ValueError(
@@ -441,18 +469,32 @@ def offload_update_and_apply(
 
     The fp32 master params and the Adam moments live permanently in pinned
     host memory; the device holds a bf16 compute copy of the params (the
-    memory win) whose gradients stream down once per step. The FULL optax
-    chain (clip + AdamW + schedule) and ``apply_updates`` run on the host
-    CPU via ``compute_on("device_host")`` in fp32 against the master
-    weights — full-precision Adam, unlike ``--param-dtype bf16`` whose
-    moments and updates round to bf16 — and only the refreshed bf16
-    compute copy streams back. Per-step PCIe traffic: ~2x bf16-params
-    (grads down + compute copy up). Device HBM never holds moments,
-    masters, or update tensors.
+    memory win) whose gradients stream down once per step. AdamW (+warmup
+    schedule) and ``apply_updates`` run on the host CPU via
+    ``compute_on("device_host")`` in fp32 against the master weights —
+    full-precision Adam, unlike ``--param-dtype bf16`` whose moments and
+    updates round to bf16 — and only the refreshed bf16 compute copy
+    streams back. Per-step PCIe traffic: ~2x bf16-params (grads down +
+    compute copy up). Device HBM never holds moments, masters, or update
+    tensors.
+
+    Round-5 changes (PERFORMANCE.md §13):
+    - global-norm CLIPPING moved to the device: the norm is a cheap fused
+      reduction over grads that are already in HBM; only the resulting
+      scale scalar crosses to the host, where it folds into the fp32
+      upcast pass the host math does anyway. The checkpointed state keeps
+      the full optax chain structure (clip state is ``EmptyState``).
+    - ``offload_delayed_update``: the host consumes LAST step's grads
+      (parked in pinned host memory with their own clip scale) while this
+      step's fresh grads stream down beside it — inside one program the
+      host call has no dependency on this step's forward/backward, so
+      XLA's latency-hiding scheduler overlaps the multi-second host Adam
+      with device compute. Params lag one step (DeepSpeed
+      delayed_param_update semantics, opt-in via --offload-delayed-update).
     """
     from jax.experimental.compute_on import compute_on
 
-    tx = _base_optimizer(strategy)
+    adamw = _adamw_only(strategy)
     is_spec = lambda x: isinstance(x, P)
     host = lambda specs: jax.tree.map(
         lambda spec: NamedSharding(mesh, spec).with_memory_kind("pinned_host"),
@@ -461,24 +503,61 @@ def offload_update_and_apply(
     dev = lambda specs: jax.tree.map(
         lambda spec: NamedSharding(mesh, spec), specs, is_leaf=is_spec
     )
-    gh = jax.device_put(grads, host(grad_specs))
+
+    # Device-side clip: exact optax.clip_by_global_norm semantics
+    # (scale = 1 when the norm is under the limit, limit/norm otherwise).
+    if strategy.grad_clip is not None:
+        gnorm = optax.global_norm(
+            jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        )
+        limit = jnp.float32(strategy.grad_clip)
+        # = optax.clip_by_global_norm's scale: 1 under the limit,
+        # limit/gnorm above it; no inf in either where-branch.
+        scale = limit / jnp.maximum(gnorm, limit)
+    else:
+        scale = jnp.float32(1.0)
+
+    delayed = strategy.offload_delayed_update
+    if delayed:
+        master, inner, (g_use, scale_use) = opt_state
+    else:
+        master, inner = opt_state
+        g_use = jax.device_put(grads, host(grad_specs))
+        scale_use = scale
+    if strategy.grad_clip is not None:
+        clip_state, adamw_state = inner
+    else:
+        clip_state, adamw_state = None, inner
+
     # The compute-copy dtype is the device params' dtype — static trace-time
     # metadata, so no param data crosses to the host for this.
     param_dtypes = jax.tree.map(lambda p: p.dtype, params)
 
-    def host_math(g, state):
-        master, inner = state
-        g32 = jax.tree.map(lambda x: x.astype(jnp.float32), g)
-        u, inner2 = tx.update(g32, inner, master)
+    def host_math(g, s, master, adamw_state):
+        # Clip scale folds into the fp32 upcast the update needs anyway —
+        # zero extra passes over the gradient tree.
+        g32 = jax.tree.map(lambda x: x.astype(jnp.float32) * s, g)
+        u, adamw_state2 = adamw.update(g32, adamw_state, master)
         master2 = optax.apply_updates(master, u)
         compute = jax.tree.map(
             lambda m, dt: m.astype(dt), master2, param_dtypes
         )
-        return compute, (master2, inner2)
+        return compute, master2, adamw_state2
 
-    compute, new_state = compute_on("device_host")(jax.jit(host_math))(
-        gh, opt_state
+    compute, master2, adamw_state2 = compute_on("device_host")(
+        jax.jit(host_math)
+    )(g_use, scale_use, master, adamw_state)
+    inner2 = (
+        (clip_state, adamw_state2) if strategy.grad_clip is not None
+        else adamw_state2
     )
+    new_state = (master2, inner2)
+    if delayed:
+        # Park this step's (unscaled) grads + their clip scale for the next
+        # step's host update.
+        new_state = new_state + (
+            (jax.device_put(grads, host(grad_specs)), scale),
+        )
     return jax.device_put(compute, dev(param_specs)), new_state
 
 

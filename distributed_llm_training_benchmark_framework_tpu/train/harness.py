@@ -94,6 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dropout", type=float, default=None,
                    help="Override model dropout rate (default: tier's 0.1, "
                         "parity with the reference model)")
+    p.add_argument("--ring-zigzag", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="Zigzag causal load balancing on ring attention: "
+                        "auto (on for causal rings when the geometry "
+                        "allows), on (force; errors if it can't), off "
+                        "(contiguous layout — the scaling-day A/B arm)")
     p.add_argument("--causal", action="store_true",
                    help="Causal (autoregressive) attention masking. Default "
                         "off for reference parity (train_harness.py:127 "
@@ -137,6 +143,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "and run the Adam math on the host CPU (ZeRO-Offload "
                         "analogue): the fp32-master-weight path for models "
                         "whose optimizer state exceeds HBM")
+    p.add_argument("--offload-delayed-update", action="store_true",
+                   help="With --offload-opt-state: overlap the host Adam "
+                        "with the next step's forward/backward by consuming "
+                        "the previous step's gradients (DeepSpeed "
+                        "delayed_param_update semantics — params lag one "
+                        "step; step 0 performs no update)")
     p.add_argument("--param-dtype", choices=["f32", "bf16"], default=None,
                    help="Parameter/Adam-state storage dtype (default: the "
                         "arm's config, normally f32 master weights). bf16 "
@@ -223,13 +235,25 @@ def main(argv=None) -> int:
         enable_debug()
 
     strategy = resolve_strategy(args)
-    if args.param_dtype is not None or args.offload_opt_state:
+    if (
+        args.param_dtype is not None
+        or args.offload_opt_state
+        or args.offload_delayed_update
+    ):
         import dataclasses as _dc
 
         if args.param_dtype is not None:
             strategy = _dc.replace(strategy, param_dtype=args.param_dtype)
         if args.offload_opt_state:
             strategy = _dc.replace(strategy, offload_opt_state=True)
+        if args.offload_delayed_update:
+            if not strategy.offload_opt_state:
+                raise SystemExit(
+                    "--offload-delayed-update requires --offload-opt-state "
+                    "(it schedules the HOST optimizer update; there is "
+                    "nothing to delay on a device-resident optimizer)"
+                )
+            strategy = _dc.replace(strategy, offload_delayed_update=True)
     dist.setup_distributed(
         master_addr=args.master_addr,
         master_port=args.master_port,
@@ -262,6 +286,7 @@ def main(argv=None) -> int:
             attention_impl=args.attention,
             dropout=args.dropout,
             causal=args.causal,
+            ring_zigzag={"auto": None, "on": True, "off": False}[args.ring_zigzag],
             flash_block_q=args.flash_block_q,
             flash_block_k=args.flash_block_k,
             flash_block_k_bwd=args.flash_block_k_bwd,

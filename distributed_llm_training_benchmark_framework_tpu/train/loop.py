@@ -78,6 +78,7 @@ def _run_benchmark_impl(
     attention_impl: str = "reference",
     dropout: Optional[float] = None,
     causal: bool = False,
+    ring_zigzag: Optional[bool] = None,
     flash_block_q: Optional[int] = None,
     flash_block_k: Optional[int] = None,
     flash_block_k_bwd: Optional[int] = None,
@@ -147,6 +148,8 @@ def _run_benchmark_impl(
         # off, train_harness.py:127); causal rings auto-enable the zigzag
         # load-balanced layout (ops/ring_attention.py).
         overrides["causal"] = True
+    if ring_zigzag is not None:
+        overrides["ring_zigzag"] = ring_zigzag
     if n_experts > 0:
         overrides["n_experts"] = n_experts
     if flash_block_q is not None:
@@ -364,6 +367,39 @@ def _run_benchmark_impl(
             if is_main:
                 print(f"WARNING: step AOT compile for memory accounting failed: {e}")
 
+    # MoE runs: measure the expert-capacity overflow (dropped-assignment
+    # fraction) on the trained params with one diagnostic forward — the
+    # published row's routing-health column (models.tinygpt
+    # .moe_overflow_fraction). Best-effort: sharded geometries the
+    # diagnostic can't replicate under skip with a warning, not a failure.
+    expert_overflow_pct = None
+    # The interleaved schedule physically PERMUTES the stacked layer axis
+    # (parallel/interleaved.py layer_permutation), so a plain apply_blocks
+    # forward over those params would run layers out of order and publish a
+    # silently wrong number — skip rather than mislead.
+    interleaved_params = pp > 1 and pipeline_schedule == "interleaved"
+    if n_experts > 0 and not interleaved_params:
+        try:
+            import functools
+
+            from jax.sharding import NamedSharding
+
+            from ..models import tinygpt as _tg
+            from ..parallel import strategies as strat_mod
+
+            ov_batch = jax.device_put(
+                ds.batch_for_step(0, global_micro),
+                NamedSharding(mesh, strat_mod.batch_partition_spec(mesh)),
+            )
+            with jax.set_mesh(mesh):
+                frac = jax.jit(
+                    functools.partial(_tg.moe_overflow_fraction, state.model_config)
+                )(params, ov_batch)
+            expert_overflow_pct = round(float(jax.device_get(frac)) * 100.0, 4)
+        except Exception as e:
+            if is_main:
+                print(f"WARNING: MoE overflow diagnostic skipped: {e}")
+
     result = metrics_mod.compute_result(
         strategy=strategy.name,
         world_size=world_size,
@@ -397,7 +433,13 @@ def _run_benchmark_impl(
         remat_policy=state.model_config.remat,
         param_dtype=strategy.param_dtype,
         offload_opt_state=strategy.offload_opt_state,
+        offload_delayed_update=strategy.offload_delayed_update,
         causal=model_config.causal,
+        ring_zigzag=(
+            "auto" if model_config.ring_zigzag is None
+            else "on" if model_config.ring_zigzag else "off"
+        ),
+        expert_overflow_pct=expert_overflow_pct,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

@@ -212,10 +212,21 @@ class BenchmarkResult:
     # run identity for arms sharing (strategy, tier, seq) geometry.
     param_dtype: str = "f32"
     offload_opt_state: bool = False
+    # Delayed (one-step-stale) host update — changes training semantics,
+    # so it is run identity (an overlapped arm is not the serial arm).
+    offload_delayed_update: bool = False
     # Causal (autoregressive) masking — False is reference parity
     # (train_harness.py:127 applies no mask); True halves attention FLOPs
     # and, on causal rings, turns on the zigzag load-balanced layout.
     causal: bool = False
+    # Ring-attention zigzag layout mode ('auto'/'on'/'off') — run identity
+    # for the scaling-day zigzag A/B arms, which differ in nothing else.
+    ring_zigzag: str = "auto"
+    # MoE runs: measured fraction (%) of (token, choice) expert assignments
+    # dropped by the capacity limit on the trained params (models.tinygpt
+    # .moe_overflow_fraction diagnostic); None for dense runs or when the
+    # diagnostic could not run under the run's sharding.
+    expert_overflow_pct: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -258,7 +269,10 @@ def compute_result(
     remat_policy: str = "none",
     param_dtype: str = "f32",
     offload_opt_state: bool = False,
+    offload_delayed_update: bool = False,
     causal: bool = False,
+    ring_zigzag: str = "auto",
+    expert_overflow_pct: Optional[float] = None,
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
@@ -339,7 +353,10 @@ def compute_result(
         remat_policy=remat_policy,
         param_dtype=param_dtype,
         offload_opt_state=offload_opt_state,
+        offload_delayed_update=offload_delayed_update,
         causal=causal,
+        ring_zigzag=ring_zigzag,
+        expert_overflow_pct=expert_overflow_pct,
     )
 
 

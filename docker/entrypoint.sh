@@ -57,7 +57,9 @@ export EXPERT_PARALLEL="${EXPERT_PARALLEL:-1}"
 export NUM_EXPERTS="${NUM_EXPERTS:-0}"
 export PARAM_DTYPE="${PARAM_DTYPE:-}"
 export OFFLOAD_OPT_STATE="${OFFLOAD_OPT_STATE:-0}"
+export OFFLOAD_DELAYED_UPDATE="${OFFLOAD_DELAYED_UPDATE:-0}"
 export CAUSAL="${CAUSAL:-0}"
+export RING_ZIGZAG="${RING_ZIGZAG:-auto}"
 
 echo "Config:"
 for v in STRATEGY WORLD_SIZE NUM_PROCESSES RANK MASTER_ADDR MASTER_PORT \
@@ -100,8 +102,12 @@ if [ -n "${PARAM_DTYPE}" ]; then
   ARGS="${ARGS} --param-dtype ${PARAM_DTYPE}"; fi
 if [ "${OFFLOAD_OPT_STATE}" = "1" ]; then
   ARGS="${ARGS} --offload-opt-state"; fi
+if [ "${OFFLOAD_DELAYED_UPDATE}" = "1" ]; then
+  ARGS="${ARGS} --offload-delayed-update"; fi
 if [ "${CAUSAL}" = "1" ]; then
   ARGS="${ARGS} --causal"; fi
+if [ "${RING_ZIGZAG}" != "auto" ]; then
+  ARGS="${ARGS} --ring-zigzag ${RING_ZIGZAG}"; fi
 if [[ "${SYNTHETIC}" == "true" ]]; then ARGS="${ARGS} --synthetic"; fi
 if [[ "${STRATEGY}" == "zero2" || "${STRATEGY}" == "zero3" ]]; then
   ARGS="${ARGS} --strategy-config /app/configs/strategies/${STRATEGY}.json"

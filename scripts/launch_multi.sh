@@ -28,7 +28,9 @@ EXPERT_PARALLEL=1
 NUM_EXPERTS=0
 PARAM_DTYPE=""
 OFFLOAD_OPT_STATE=0
+OFFLOAD_DELAYED_UPDATE=0
 CAUSAL=0
+RING_ZIGZAG="auto"
 IMAGE="tpu-llm-bench:latest"
 TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
@@ -56,7 +58,9 @@ while [ $# -gt 0 ]; do
     --num-experts) NUM_EXPERTS="$2"; shift 2 ;;
     --param-dtype) PARAM_DTYPE="$2"; shift 2 ;;
     --offload-opt-state) OFFLOAD_OPT_STATE=1; shift 1 ;;
+    --offload-delayed-update) OFFLOAD_DELAYED_UPDATE=1; shift 1 ;;
     --causal) CAUSAL=1; shift 1 ;;
+    --ring-zigzag) RING_ZIGZAG="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
     --job-name) JOB_NAME="$2"; shift 2 ;;
@@ -98,7 +102,9 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{NUM_EXPERTS}}|$NUM_EXPERTS|g" \
     -e "s|{{PARAM_DTYPE}}|$PARAM_DTYPE|g" \
     -e "s|{{OFFLOAD_OPT_STATE}}|$OFFLOAD_OPT_STATE|g" \
+    -e "s|{{OFFLOAD_DELAYED_UPDATE}}|$OFFLOAD_DELAYED_UPDATE|g" \
     -e "s|{{CAUSAL}}|$CAUSAL|g" \
+    -e "s|{{RING_ZIGZAG}}|$RING_ZIGZAG|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \
     -e "s|{{TPU_ACCELERATOR}}|$TPU_ACCELERATOR|g" \
     -e "s|{{TPU_TOPOLOGY}}|$TPU_TOPOLOGY|g" \

@@ -63,6 +63,13 @@ fi
 # (reference scripts/run_all_benchmarks.sh fixed strategy x gpu grid).
 # COMPOSITIONS=off disables; =only skips the pure-strategy matrix.
 COMPOSITIONS="${COMPOSITIONS:-auto}"
+# SUITE_DRY_RUN=1: print the planned run list (one "PLAN <mode> <name>
+# strategy=<s> ws=<n> flags=<...>" line per run) without executing anything
+# — the hermetic contract for the multi-chip day-one suite shape
+# (tests/test_suite_plan.py asserts the {strategies} x {1,2,4,..,N} matrix
+# + composition roster against a faked device count). Analysis/validation
+# are skipped too (there is nothing to analyze).
+SUITE_DRY_RUN="${SUITE_DRY_RUN:-0}"
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -114,6 +121,10 @@ run_local() {
   [ "$ATTENTION" != "reference" ] && name="${name}-${ATTENTION}"
   [ -n "$suffix" ] && name="${name}-${suffix}"
   local log="$RESULTS_DIR/${name}.log"
+  if [ "$SUITE_DRY_RUN" = "1" ]; then
+    echo "PLAN local $name strategy=$strategy ws=$ws flags=$extra"
+    PASS=$((PASS+1)); return
+  fi
   echo "--- $name ---"
   local t0=$(date +%s)
   if timeout "$TIMEOUT_PER_RUN" python -u benchmarking/train_harness.py \
@@ -144,6 +155,10 @@ run_k8s() {
   # are ephemeral — the scrape is the only copy).
   local job="tpu-bench-${strategy}-ws${ws}"
   [ -n "$suffix" ] && job="${job}-${suffix}"
+  if [ "$SUITE_DRY_RUN" = "1" ]; then
+    echo "PLAN k8s $job strategy=$strategy ws=$ws flags=$comp"
+    PASS=$((PASS+1)); return
+  fi
   echo "--- $job (k8s) ---"
   scripts/launch_multi.sh --strategy "$strategy" --world-size "$ws" \
     --seq-len "$SEQ_LEN" --tier "$TIER" --steps "$STEPS" \
@@ -185,8 +200,10 @@ pp2-1f1b|ddp|--pipeline-parallel 2 --pipeline-schedule 1f1b|--pipeline-parallel 
 pp2-interleaved|ddp|--pipeline-parallel 2 --pipeline-schedule interleaved --virtual-stages $VIRT|--pipeline-parallel 2 --pipeline-schedule interleaved --virtual-stages $VIRT
 sp2-ring|zero2|--sequence-parallel 2 --attention ring|--sequence-parallel 2 --attention ring
 sp2-ring-causal|zero2|--sequence-parallel 2 --attention ring --causal|--sequence-parallel 2 --attention ring --causal
+sp2-ring-causal-nozz|zero2|--sequence-parallel 2 --attention ring --causal --ring-zigzag off|--sequence-parallel 2 --attention ring --causal --ring-zigzag off
 sp2-ulysses|zero2|--sequence-parallel 2 --attention ulysses|--sequence-parallel 2 --attention ulysses
 moe-ep2|zero2|--num-experts 4 --expert-parallel 2|--num-experts 4 --expert-parallel 2
+moe8-ep2|zero2|--num-experts 8 --expert-parallel 2|--num-experts 8 --expert-parallel 2
 "
   echo ""
   echo "=== Composition arms (ws=$WS_MAX) ==="
@@ -205,6 +222,12 @@ moe-ep2|zero2|--num-experts 4 --expert-parallel 2|--num-experts 4 --expert-paral
   done <<EOF
 $ROSTER
 EOF
+fi
+
+if [ "$SUITE_DRY_RUN" = "1" ]; then
+  echo ""
+  echo "=== Dry run: $PASS runs planned, nothing executed ==="
+  exit 0
 fi
 
 echo ""

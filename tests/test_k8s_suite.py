@@ -79,12 +79,17 @@ if "logs" in argv:
         result.update(pipeline_parallel=2, pipeline_schedule=comp[4:])
     elif comp.startswith("sp2-"):
         att = comp[4:]
+        if att.endswith("-nozz"):
+            att = att[:-len("-nozz")]
+            result["ring_zigzag"] = "off"
         if att.endswith("-causal"):
             att = att[:-len("-causal")]
             result["causal"] = True
         result.update(sequence_parallel=2, attention_impl=att)
     elif comp == "moe-ep2":
         result.update(expert_parallel=2, n_experts=4)
+    elif comp == "moe8-ep2":
+        result.update(expert_parallel=2, n_experts=8)
     print("boot log line")
     print("BENCHMARK_RESULT_JSON_START")
     print(json.dumps(result, indent=2))
@@ -198,8 +203,10 @@ COMP_JOBS = {
     "tpu-bench-ddp-ws4-pp2-interleaved",
     "tpu-bench-zero2-ws4-sp2-ring",
     "tpu-bench-zero2-ws4-sp2-ring-causal",
+    "tpu-bench-zero2-ws4-sp2-ring-causal-nozz",
     "tpu-bench-zero2-ws4-sp2-ulysses",
     "tpu-bench-zero2-ws4-moe-ep2",
+    "tpu-bench-zero2-ws4-moe8-ep2",
 }
 
 
@@ -232,10 +239,10 @@ def roster_run(tmp_path_factory):
     return proc, tmp, results
 
 
-def test_roster_exits_zero_with_eight_arms(roster_run):
+def test_roster_exits_zero_with_ten_arms(roster_run):
     proc, _, _ = roster_run
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
-    assert "8 passed, 0 failed" in proc.stdout
+    assert "10 passed, 0 failed" in proc.stdout
 
 
 def test_roster_job_names_and_manifest_env(roster_run):
@@ -259,6 +266,9 @@ def test_roster_job_names_and_manifest_env(roster_run):
     assert 'name: CAUSAL\n              value: "0"' in ring
     zz = (tmp / "manifest_tpu-bench-zero2-ws4-sp2-ring-causal.yaml").read_text()
     assert 'name: CAUSAL\n              value: "1"' in zz
+    assert 'name: RING_ZIGZAG\n              value: "auto"' in zz
+    nozz = (tmp / "manifest_tpu-bench-zero2-ws4-sp2-ring-causal-nozz.yaml").read_text()
+    assert 'name: RING_ZIGZAG\n              value: "off"' in nozz
     moe = (tmp / "manifest_tpu-bench-zero2-ws4-moe-ep2.yaml").read_text()
     assert 'name: OFFLOAD_OPT_STATE\n              value: "0"' in moe
     assert 'name: NUM_EXPERTS\n              value: "4"' in moe
@@ -276,7 +286,9 @@ def test_roster_rows_survive_dedup(roster_run):
     import pandas as pd
 
     df = pd.read_csv(results / "summary" / "metrics.csv")
-    # 8 composition runs, all (strategy, ws)-colliding pairs kept distinct
+    # 10 composition runs, all (strategy, ws)-colliding pairs kept distinct
     # by the composition axes in the identity key (sp2-ring vs
-    # sp2-ring-causal collide on everything except the causal column).
-    assert len(df) == 8, df
+    # sp2-ring-causal collide on everything except the causal column; the
+    # zigzag A/B pair only on ring_zigzag; the two MoE arms only on
+    # n_experts).
+    assert len(df) == 10, df

@@ -144,3 +144,27 @@ def test_moe_composes_with_pipeline(eight_devices):
     plain = np.mean([float(loss_fn(cfg, params, batch[i], batch[i]))
                      for i in range(2)])
     np.testing.assert_allclose(float(pl), plain, rtol=2e-3)
+
+
+def test_moe_overflow_fraction_diagnostic():
+    """The routing-health diagnostic: overflow fraction is a sane [0,1)
+    number at a tight capacity factor and exactly 0 when capacity is
+    effectively unlimited (nothing can drop)."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llm_training_benchmark_framework_tpu.models import (
+        get_model_config,
+        init_params,
+        tinygpt,
+    )
+
+    cfg = get_model_config("S", 64, dropout=0.0, n_experts=4,
+                           capacity_factor=1.0)
+    params = init_params(cfg, jax.random.key(0))
+    idx = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg.vocab_size)
+    frac = float(tinygpt.moe_overflow_fraction(cfg, params, idx))
+    assert 0.0 <= frac < 1.0
+    roomy = dataclasses.replace(cfg, capacity_factor=8.0)
+    assert float(tinygpt.moe_overflow_fraction(roomy, params, idx)) == 0.0

@@ -200,3 +200,65 @@ def test_deepspeed_offload_optimizer_maps_to_offload_opt_state():
     assert not off.offload_opt_state
     absent = from_deepspeed_config({"zero_optimization": {"stage": 3}}, "zero3")
     assert not absent.offload_opt_state
+
+
+def test_delayed_update_state_structure_and_specs():
+    """--offload-delayed-update extends the optimizer state with (pending
+    grads, clip scale) parked alongside the masters; partition-spec
+    derivation must give the pending tree param specs (pinned-host on TPU)
+    and the scalar P() — the layout checkpoints and resumes through orbax."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+        make_mesh,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        strategies as strat,
+    )
+
+    s = dataclasses.replace(
+        get_strategy("zero3"), offload_opt_state=True,
+        offload_delayed_update=True,
+    )
+    opt = strat.make_optimizer(s)
+    params = {"w": jnp.zeros((8, 4), jnp.bfloat16), "b": jnp.zeros((4,), jnp.bfloat16)}
+    state = opt.init(params)
+    assert len(state) == 3
+    master, inner, (pending, scale) = state
+    assert jax.tree.structure(pending) == jax.tree.structure(params)
+    assert pending["w"].dtype == jnp.bfloat16  # device grad dtype, not fp32
+    assert scale.shape == ()
+    # Spec derivation covers the extended tree: pending leaves get real
+    # specs, the scale scalar replicates.
+    mesh = make_mesh((1,), ("data",), devices=jax.devices()[:1])
+    pspecs = strat.param_partition_specs(params, mesh, shard=True)
+    ospecs = strat.opt_state_partition_specs(opt, params, pspecs, mesh, shard=True)
+    assert ospecs[2][1] == P()
+    assert jax.tree.structure(ospecs[2][0]) == jax.tree.structure(params)
+
+
+def test_delayed_update_requires_offload(tmp_path):
+    """--offload-delayed-update without --offload-opt-state is a config
+    error, not a silent no-op."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "distributed_llm_training_benchmark_framework_tpu.train.harness",
+            "--strategy", "ddp", "--world-size", "1", "--tier", "S",
+            "--seq-len", "64", "--steps", "1", "--per-device-batch", "1",
+            "--grad-accum", "1", "--offload-delayed-update",
+            "--results-dir", str(tmp_path),
+        ],
+        capture_output=True, text=True, timeout=120,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode != 0
+    assert "requires --offload-opt-state" in proc.stderr + proc.stdout

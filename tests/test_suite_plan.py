@@ -1,0 +1,87 @@
+"""The multi-chip day-one contract: what ONE suite invocation will run.
+
+The standing hardware-blocked item (single chip here) is the measured
+scaling matrix. This pins — hermetically, against a FAKED 8-device
+backend — that on allocation day `scripts/run_all_benchmarks.sh` needs
+zero new code: SUITE_DRY_RUN=1 prints the exact run plan, and these tests
+assert it is the reference's full matrix shape
+(`/root/reference/scripts/run_all_benchmarks.sh` hard-codes strategy x
+gpu-count) widened to {strategies} x {1, 2, 4, 8} (a true ws=1 baseline,
+which the reference lacked) PLUS the 10-arm composition roster at the
+widest world size — including the zigzag-on/off causal ring A/B pair
+whose wall-clock difference is THE scaling-day measurement for the
+round-4 ring work.
+"""
+
+import os
+import re
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+COMPOSITION_ARMS = {
+    "tp2", "pp2-gpipe", "pp2-1f1b", "pp2-interleaved",
+    "sp2-ring", "sp2-ring-causal", "sp2-ring-causal-nozz", "sp2-ulysses",
+    "moe-ep2", "moe8-ep2",
+}
+
+
+def _plan(extra_env, *args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["SUITE_DRY_RUN"] = "1"
+    env.update(extra_env)
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "run_all_benchmarks.sh"), *args],
+        capture_output=True, text=True, env=env, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    return [l for l in proc.stdout.splitlines() if l.startswith("PLAN ")]
+
+
+def test_local_plan_is_full_matrix_plus_roster_on_8_faked_chips(tmp_path):
+    plans = _plan({"RESULTS_DIR": str(tmp_path), "TIER": "S", "SEQ_LEN": "128"})
+    matrix = [p for p in plans if re.search(r"flags=\s*$", p)]
+    # 4 strategies x {1, 2, 4, 8} detected from the faked backend.
+    assert len(matrix) == 16, "\n".join(plans)
+    for strategy in ("ddp", "fsdp", "zero2", "zero3"):
+        ws = {
+            int(re.search(r"ws=(\d+)", p).group(1))
+            for p in matrix if f"strategy={strategy} " in p
+        }
+        assert ws == {1, 2, 4, 8}, (strategy, ws)
+    # The composition roster rides the widest world size.
+    comps = [p for p in plans if not re.search(r"flags=\s*$", p)]
+    names = {
+        re.search(r"PLAN local bench-\w+-ws8-seq128-(\S+)", p).group(1)
+        for p in comps
+    }
+    assert names == COMPOSITION_ARMS, names
+    for p in comps:
+        assert "ws=8" in p
+    zz = [p for p in comps if "sp2-ring-causal" in p]
+    assert any("--ring-zigzag off" in p for p in zz)
+    assert any("--ring-zigzag" not in p and "--causal" in p for p in zz)
+
+
+def test_k8s_plan_matches_reference_matrix_shape(tmp_path):
+    plans = _plan(
+        {"RESULTS_DIR": str(tmp_path), "TIER": "S", "SEQ_LEN": "128",
+         "WORLD_SIZES": "2 4"},
+        "--k8s",
+    )
+    matrix = [p for p in plans if re.search(r"flags=\s*$", p)]
+    # The reference's published shape: each strategy at each world size.
+    assert len(matrix) == 8, "\n".join(plans)
+    comps = [p for p in plans if not re.search(r"flags=\s*$", p)]
+    assert len(comps) == len(COMPOSITION_ARMS)
+    for p in comps:
+        assert "ws=4" in p  # widest requested size
+
+
+def test_dry_run_executes_nothing(tmp_path):
+    _plan({"RESULTS_DIR": str(tmp_path), "TIER": "S", "SEQ_LEN": "128"})
+    # No logs, no results, no summary — the planner leaves the results dir
+    # exactly as it found it (mkdir only).
+    assert os.listdir(tmp_path) == []
