@@ -149,6 +149,23 @@ def _run_benchmark_impl(
         # load-balanced layout (ops/ring_attention.py).
         overrides["causal"] = True
     if ring_zigzag is not None:
+        # The knob only has a consumer on a real ring: without --attention
+        # ring (or, for 'on', without a >1 seq axis) the model would fall
+        # back to flash and silently drop the setting while the result row
+        # still recorded it as run identity — a misconfigured A/B pair
+        # would publish a legitimate-looking zero delta. Refuse instead.
+        if attention_impl != "ring":
+            raise ValueError(
+                f"--ring-zigzag {'on' if ring_zigzag else 'off'} requires "
+                "--attention ring (the zigzag layout is a ring-attention "
+                f"property; got --attention {attention_impl})"
+            )
+        if ring_zigzag and sp <= 1:
+            raise ValueError(
+                "--ring-zigzag on requires --sequence-parallel > 1: with "
+                "one sequence shard there is no ring to balance (use "
+                "'auto', or add --sequence-parallel N)"
+            )
         overrides["ring_zigzag"] = ring_zigzag
     if n_experts > 0:
         overrides["n_experts"] = n_experts

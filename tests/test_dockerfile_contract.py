@@ -1,0 +1,81 @@
+"""Hermetic Dockerfile contract checks (no docker daemon in this env —
+standing round-1 gap: the image is the one artifact never built here, so
+freeze its load-bearing promises statically instead of taking them on
+faith).
+
+What must hold for the k8s path to work when someone DOES build it:
+
+- every COPY source exists in the repo, and the copied trees contain what
+  the entrypoint/harness import (a renamed package or a forgotten COPY is
+  the classic silently-broken-image failure);
+- the entrypoint both exists, is the ENTRYPOINT, and execs the SAME
+  harness path the COPY lines lay down;
+- the pip stack pins exact versions for jax/optax/orbax (reproducible
+  benchmarks — an unpinned jax would float the XLA version under the
+  published numbers) and installs from the libtpu release index;
+- the build-time import check (parity with the reference's
+  Dockerfile:75-78 verification RUN) imports the package by its real name;
+- the runtime env prefers TPU with a CPU fallback and sets the offline
+  posture the reference sets (HF_*_OFFLINE).
+"""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCKERFILE = open(os.path.join(REPO, "docker", "Dockerfile")).read()
+
+
+def test_copy_sources_exist():
+    for m in re.finditer(r"^COPY\s+(\S+)\s+(\S+)", DOCKERFILE, re.M):
+        src = m.group(1).rstrip("/")
+        assert os.path.exists(os.path.join(REPO, src)), f"COPY source {src} missing"
+
+
+def test_entrypoint_is_copied_and_set():
+    assert re.search(r"^COPY docker/entrypoint\.sh /app/entrypoint\.sh", DOCKERFILE, re.M)
+    assert 'ENTRYPOINT ["/app/entrypoint.sh"]' in DOCKERFILE
+    entry = open(os.path.join(REPO, "docker", "entrypoint.sh")).read()
+    m = re.search(r"exec python -u (\S+)", entry)
+    assert m, "entrypoint must exec the harness"
+    harness = m.group(1)
+    # The exec'd path must be inside a tree a COPY line provides.
+    assert harness.startswith("/app/benchmarking/"), harness
+    rel = harness[len("/app/"):]
+    assert os.path.exists(os.path.join(REPO, rel)), harness
+
+
+def test_pinned_jax_stack_with_libtpu_index():
+    assert re.search(r'"jax\[tpu\]==\d+\.\d+\.\d+"', DOCKERFILE), "jax[tpu] must be version-pinned"
+    assert "libtpu_releases.html" in DOCKERFILE
+    assert re.search(r"optax==\d", DOCKERFILE)
+    assert re.search(r"orbax-checkpoint==\d", DOCKERFILE)
+
+
+def test_build_time_import_check_uses_real_package_name():
+    assert "import distributed_llm_training_benchmark_framework_tpu" in DOCKERFILE
+    # ...and that package dir is what COPY lays down.
+    assert re.search(
+        r"^COPY distributed_llm_training_benchmark_framework_tpu/", DOCKERFILE, re.M
+    )
+
+
+def test_runtime_env_contract():
+    assert "JAX_PLATFORMS=tpu,cpu" in DOCKERFILE
+    for var in ("HF_HUB_OFFLINE=1", "TRANSFORMERS_OFFLINE=1", "HF_DATASETS_OFFLINE=1"):
+        assert var in DOCKERFILE, var
+    assert "PYTHONUNBUFFERED=1" in DOCKERFILE  # marker-scrape needs unbuffered stdout
+
+
+def test_configs_the_entrypoint_references_are_copied():
+    entry = open(os.path.join(REPO, "docker", "entrypoint.sh")).read()
+    for m in re.finditer(r"/app/(configs/\S+?\.json)", entry):
+        # Strategy configs referenced with a shell variable are checked by
+        # expanding it over the harness's strategy choices.
+        path = m.group(1)
+        if "${STRATEGY}" in path:
+            for s in ("zero2", "zero3"):
+                p = path.replace("${STRATEGY}", s)
+                assert os.path.exists(os.path.join(REPO, p)), p
+        else:
+            assert os.path.exists(os.path.join(REPO, path)), path
