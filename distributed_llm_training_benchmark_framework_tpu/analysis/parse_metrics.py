@@ -63,7 +63,8 @@ def load_results(results_dir: str) -> pd.DataFrame:
             "tensor_parallel", "sequence_parallel", "pipeline_parallel",
             "pipeline_schedule", "virtual_stages", "expert_parallel",
             "n_experts", "remat_policy", "param_dtype", "offload_opt_state",
-            "offload_delayed_update", "causal", "ring_zigzag",
+            "offload_delayed_update", "offload_dpu_start_step", "causal",
+            "ring_zigzag",
         ) if c in df.columns
     ]
     df = df.drop_duplicates(subset=key, keep="first")
@@ -84,7 +85,8 @@ def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
             "tensor_parallel", "sequence_parallel", "pipeline_parallel",
             "pipeline_schedule", "virtual_stages", "expert_parallel",
             "n_experts", "param_dtype", "offload_opt_state",
-            "offload_delayed_update", "causal", "ring_zigzag",
+            "offload_delayed_update", "offload_dpu_start_step", "causal",
+            "ring_zigzag",
         )
         if c in df.columns
     ]

@@ -149,6 +149,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "the previous step's gradients (DeepSpeed "
                         "delayed_param_update semantics — params lag one "
                         "step; step 0 performs no update)")
+    p.add_argument("--offload-dpu-start-step", type=int, default=0,
+                   help="With --offload-delayed-update: run exact serial "
+                        "host updates until this step, then switch to the "
+                        "overlapped schedule — gradient staleness "
+                        "measurably slows the steep early-descent phase "
+                        "(PERFORMANCE.md §13; DeepSpeed gates its DPU "
+                        "behind warmup for the same reason). 0 = delayed "
+                        "from the start. Incompatible with --resume")
     p.add_argument("--param-dtype", choices=["f32", "bf16"], default=None,
                    help="Parameter/Adam-state storage dtype (default: the "
                         "arm's config, normally f32 master weights). bf16 "
@@ -296,6 +304,7 @@ def main(argv=None) -> int:
                 else None
             ),
             layer_loop=args.layer_loop,
+            offload_dpu_start_step=args.offload_dpu_start_step,
             prng_impl=args.prng_impl,
             dataset_size=args.dataset_size,
             sync_every=args.sync_every,

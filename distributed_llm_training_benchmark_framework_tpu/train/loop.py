@@ -21,6 +21,7 @@ import time
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..data import SyntheticDataset
@@ -84,6 +85,7 @@ def _run_benchmark_impl(
     flash_block_k_bwd: Optional[int] = None,
     flash_pallas_backward: Optional[bool] = None,
     layer_loop: str = "scan",
+    offload_dpu_start_step: int = 0,
     dataset_size: int = 1000,
     log_every: int = 10,
     sync_every: int = 1,
@@ -252,12 +254,71 @@ def _run_benchmark_impl(
                 f"{refusal}\nPass --skip-memory-check to attempt the run anyway."
             )
 
+    if offload_dpu_start_step > 0:
+        # Delayed-update staleness measurably slows the STEEP early-descent
+        # phase (PERFORMANCE.md §13 — DeepSpeed gates its DPU behind warmup
+        # for the same reason), so this knob runs exact serial host updates
+        # until the given step, then switches to the overlapped schedule at
+        # a sync boundary. Resume is refused with it: the two phases
+        # checkpoint different optimizer-state layouts.
+        if not strategy.offload_delayed_update:
+            raise ValueError(
+                "--offload-dpu-start-step requires --offload-delayed-update"
+            )
+        if resume:
+            raise ValueError(
+                "--offload-dpu-start-step is incompatible with --resume "
+                "(the serial and delayed phases checkpoint different "
+                "optimizer-state layouts); restart the run, or drop the "
+                "start-step knob"
+            )
+        if offload_dpu_start_step >= steps:
+            # An out-of-range start step would run the WHOLE benchmark
+            # serial while the result row records the delayed identity —
+            # the same silent-A/B-corruption class the --ring-zigzag
+            # refusal exists for.
+            raise ValueError(
+                f"--offload-dpu-start-step {offload_dpu_start_step} >= "
+                f"--steps {steps}: the delayed phase would never begin "
+                "(drop the knob for a fully-serial run)"
+            )
+        if offload_dpu_start_step > warmup_steps and is_main:
+            print(
+                f"WARNING: --offload-dpu-start-step {offload_dpu_start_step} "
+                f"> --warmup-steps {warmup_steps}: timed windows will mix "
+                "serial and delayed step times into one result row; set the "
+                "start step inside the untimed warmup for clean timing"
+            )
+
     t_init = time.perf_counter()
     state = create_train_state(
         model_config, strategy, mesh, seed=seed, grad_accum=grad_accum,
         from_table=True, global_micro=global_micro, seq_len=seq_len,
         pipeline_schedule=pipeline_schedule, virtual_stages=virtual_stages,
     )
+    serial_state = None
+    pending_template = None
+    if strategy.offload_delayed_update and offload_dpu_start_step > 0:
+        import dataclasses as _dc
+
+        # Keep only the DPU state's step_fn + the pending slot's layout;
+        # free its initial arrays BEFORE building the serial state, so the
+        # memory-tight offload arm never holds two full copies of
+        # params/masters/moments (the serial phase re-creates them).
+        pending_template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+            state.opt_state[2],
+        )
+        for leaf in jax.tree.leaves((state.params, state.opt_state)):
+            leaf.delete()
+        serial_state = create_train_state(
+            model_config,
+            _dc.replace(strategy, offload_delayed_update=False),
+            mesh, seed=seed, grad_accum=grad_accum,
+            from_table=True, global_micro=global_micro, seq_len=seq_len,
+            pipeline_schedule=pipeline_schedule,
+            virtual_stages=virtual_stages,
+        )
     if is_main:
         print(f"Model initialized: {state.n_params/1e6:.2f}M parameters")
         print(f"Init time: {time.perf_counter() - t_init:.1f}s")
@@ -280,7 +341,8 @@ def _run_benchmark_impl(
         )
     else:
         table = jax.device_put(ds.data, replicated)
-    params, opt_state = state.params, state.opt_state
+    active_state = serial_state if serial_state is not None else state
+    params, opt_state = active_state.params, active_state.opt_state
     step_times, losses = [], []
     trace_started = False
 
@@ -345,14 +407,48 @@ def _run_benchmark_impl(
             # into the first timed window.
             sync_window(t_window)
             t_window = time.perf_counter()
-        params, opt_state, loss = state.step_fn(params, opt_state, table, step)
+        if serial_state is not None and step == offload_dpu_start_step:
+            # Serial -> delayed transition at a sync boundary: extend the
+            # optimizer state with an empty pending-grads slot (pinned
+            # host). The first delayed step applies one zero-grad
+            # "momentum-ghost" update while its own grads prime the
+            # pipeline — the price of entering the overlap, far below the
+            # steep-phase staleness it avoids (PERFORMANCE.md §13).
+            sync_window(t_window)
+
+            def zeros_like_tpl(s):
+                if jax.process_count() > 1:
+                    # device_put of a host array cannot target
+                    # non-addressable devices; assemble per-shard instead
+                    # (same pattern as the dataset table above).
+                    return jax.make_array_from_callback(
+                        s.shape, s.sharding,
+                        lambda idx: np.zeros(s.shape, s.dtype)[idx],
+                    )
+                return jax.device_put(jnp.zeros(s.shape, s.dtype), s.sharding)
+
+            opt_state = opt_state + (
+                jax.tree.map(zeros_like_tpl, pending_template),
+            )
+            active_state = state
+            if is_main:
+                print(f"[Step {step:04d}] delayed-update phase begins")
+            t_window = time.perf_counter()
+        params, opt_state, loss = active_state.step_fn(params, opt_state, table, step)
         pending.append((step, loss))
         if len(pending) >= sync_every or step == steps - 1:
             sync_window(t_window)
             t_window = time.perf_counter()
         # Checkpointing happens at a sync boundary, outside the next timed
-        # window, so benchmark step times stay honest.
-        if ckpt is not None and ckpt.should_save(step):
+        # window, so benchmark step times stay honest. The serial phase of
+        # a --offload-dpu-start-step run is NOT checkpointed: its 2-tuple
+        # opt-state layout could not be restored by either arm's resume
+        # template (and resume is refused with the knob anyway).
+        if (
+            ckpt is not None
+            and ckpt.should_save(step)
+            and (serial_state is None or step >= offload_dpu_start_step)
+        ):
             sync_window(t_window)
             ckpt.save(step, params, opt_state)
             if is_main:
@@ -379,7 +475,7 @@ def _run_benchmark_impl(
     compiled_step = None
     if metrics_mod.peak_hbm_bytes() is None:
         try:
-            compiled_step = state.aot_compile(params, opt_state, table, 0)
+            compiled_step = active_state.aot_compile(params, opt_state, table, 0)
         except Exception as e:  # degrade down the fallback chain, never fail a run
             if is_main:
                 print(f"WARNING: step AOT compile for memory accounting failed: {e}")
@@ -451,6 +547,7 @@ def _run_benchmark_impl(
         param_dtype=strategy.param_dtype,
         offload_opt_state=strategy.offload_opt_state,
         offload_delayed_update=strategy.offload_delayed_update,
+        offload_dpu_start_step=offload_dpu_start_step,
         causal=model_config.causal,
         ring_zigzag=(
             "auto" if model_config.ring_zigzag is None

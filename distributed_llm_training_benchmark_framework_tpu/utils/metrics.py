@@ -215,6 +215,9 @@ class BenchmarkResult:
     # Delayed (one-step-stale) host update — changes training semantics,
     # so it is run identity (an overlapped arm is not the serial arm).
     offload_delayed_update: bool = False
+    # First delayed step when the serial->delayed transition knob is used
+    # (0 = delayed from the start); also run identity.
+    offload_dpu_start_step: int = 0
     # Causal (autoregressive) masking — False is reference parity
     # (train_harness.py:127 applies no mask); True halves attention FLOPs
     # and, on causal rings, turns on the zigzag load-balanced layout.
@@ -270,6 +273,7 @@ def compute_result(
     param_dtype: str = "f32",
     offload_opt_state: bool = False,
     offload_delayed_update: bool = False,
+    offload_dpu_start_step: int = 0,
     causal: bool = False,
     ring_zigzag: str = "auto",
     expert_overflow_pct: Optional[float] = None,
@@ -354,6 +358,7 @@ def compute_result(
         param_dtype=param_dtype,
         offload_opt_state=offload_opt_state,
         offload_delayed_update=offload_delayed_update,
+        offload_dpu_start_step=offload_dpu_start_step,
         causal=causal,
         ring_zigzag=ring_zigzag,
         expert_overflow_pct=expert_overflow_pct,

@@ -58,6 +58,7 @@ export NUM_EXPERTS="${NUM_EXPERTS:-0}"
 export PARAM_DTYPE="${PARAM_DTYPE:-}"
 export OFFLOAD_OPT_STATE="${OFFLOAD_OPT_STATE:-0}"
 export OFFLOAD_DELAYED_UPDATE="${OFFLOAD_DELAYED_UPDATE:-0}"
+export OFFLOAD_DPU_START_STEP="${OFFLOAD_DPU_START_STEP:-0}"
 export CAUSAL="${CAUSAL:-0}"
 export RING_ZIGZAG="${RING_ZIGZAG:-auto}"
 
@@ -104,6 +105,8 @@ if [ "${OFFLOAD_OPT_STATE}" = "1" ]; then
   ARGS="${ARGS} --offload-opt-state"; fi
 if [ "${OFFLOAD_DELAYED_UPDATE}" = "1" ]; then
   ARGS="${ARGS} --offload-delayed-update"; fi
+if [ "${OFFLOAD_DPU_START_STEP}" != "0" ]; then
+  ARGS="${ARGS} --offload-dpu-start-step ${OFFLOAD_DPU_START_STEP}"; fi
 if [ "${CAUSAL}" = "1" ]; then
   ARGS="${ARGS} --causal"; fi
 if [ "${RING_ZIGZAG}" != "auto" ]; then

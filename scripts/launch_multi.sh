@@ -29,6 +29,7 @@ NUM_EXPERTS=0
 PARAM_DTYPE=""
 OFFLOAD_OPT_STATE=0
 OFFLOAD_DELAYED_UPDATE=0
+OFFLOAD_DPU_START_STEP=0
 CAUSAL=0
 RING_ZIGZAG="auto"
 IMAGE="tpu-llm-bench:latest"
@@ -59,6 +60,7 @@ while [ $# -gt 0 ]; do
     --param-dtype) PARAM_DTYPE="$2"; shift 2 ;;
     --offload-opt-state) OFFLOAD_OPT_STATE=1; shift 1 ;;
     --offload-delayed-update) OFFLOAD_DELAYED_UPDATE=1; shift 1 ;;
+    --offload-dpu-start-step) OFFLOAD_DPU_START_STEP="$2"; shift 2 ;;
     --causal) CAUSAL=1; shift 1 ;;
     --ring-zigzag) RING_ZIGZAG="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
@@ -103,6 +105,7 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{PARAM_DTYPE}}|$PARAM_DTYPE|g" \
     -e "s|{{OFFLOAD_OPT_STATE}}|$OFFLOAD_OPT_STATE|g" \
     -e "s|{{OFFLOAD_DELAYED_UPDATE}}|$OFFLOAD_DELAYED_UPDATE|g" \
+    -e "s|{{OFFLOAD_DPU_START_STEP}}|$OFFLOAD_DPU_START_STEP|g" \
     -e "s|{{CAUSAL}}|$CAUSAL|g" \
     -e "s|{{RING_ZIGZAG}}|$RING_ZIGZAG|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \

@@ -262,3 +262,35 @@ def test_delayed_update_requires_offload(tmp_path):
     )
     assert proc.returncode != 0
     assert "requires --offload-opt-state" in proc.stderr + proc.stdout
+
+
+def test_dpu_start_step_validation(tmp_path):
+    """--offload-dpu-start-step demands the delayed-update arm, and refuses
+    --resume (the two phases checkpoint different optimizer-state
+    layouts). Both refusals fire before any device work."""
+    import os
+    import subprocess
+    import sys
+
+    def run(*extra):
+        return subprocess.run(
+            [
+                sys.executable, "-m",
+                "distributed_llm_training_benchmark_framework_tpu.train.harness",
+                "--strategy", "zero3", "--world-size", "1", "--tier", "S",
+                "--seq-len", "64", "--steps", "1", "--per-device-batch", "1",
+                "--grad-accum", "1", "--results-dir", str(tmp_path), *extra,
+            ],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+    p = run("--offload-dpu-start-step", "5")
+    assert p.returncode != 0
+    assert "requires --offload-delayed-update" in p.stderr + p.stdout
+
+    p = run("--offload-opt-state", "--offload-delayed-update",
+            "--offload-dpu-start-step", "5", "--resume",
+            "--checkpoint-dir", str(tmp_path / "ck"))
+    assert p.returncode != 0
+    assert "incompatible with --resume" in p.stderr + p.stdout
