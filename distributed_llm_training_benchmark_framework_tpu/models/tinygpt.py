@@ -132,11 +132,70 @@ class TinyGPTConfig:
     # dispatch einsums to all-to-all on its own). 'alltoall' forces the
     # explicit path (raises if the geometry can't), 'einsum' forces GSPMD.
     moe_dispatch: str = "auto"
+    # ------------------------------------------------------------------
+    # Architecture-family knobs (models.llama sets these; the defaults
+    # reproduce the reference TinyGPT architecture bit-for-bit — reference
+    # train_harness.py:36-131 has none of these options).
+    # ------------------------------------------------------------------
+    # Normalization: 'layernorm' (mean+var, learned scale/bias) or 'rmsnorm'
+    # (no mean subtraction, scale only — Llama). Statistics always fp32.
+    norm: str = "layernorm"
+    norm_eps: float = 1e-5
+    # Position information: 'learned' (additive wpe table, the reference
+    # design) or 'rope' (rotary embedding applied to q/k per head — no
+    # positional parameters at all, and block_size no longer bounds the
+    # table, only the benchmark geometry).
+    pos_embed: str = "learned"
+    rope_theta: float = 10000.0
+    # MLP: 'gelu' (D -> mlp_dim -> exact-erf GELU -> D, the reference MLP)
+    # or 'swiglu' (gate/up pair, silu(gate)*up -> down — Llama).
+    mlp_act: str = "gelu"
+    # Hidden width of the MLP. None = 4*n_embd (the reference ratio). The
+    # Llama family passes an explicit width (~8/3*D rounded for SwiGLU's
+    # iso-parameter budget across its three matrices).
+    mlp_hidden: Optional[int] = None
+    # Grouped-query attention: number of K/V heads. None = n_head (MHA).
+    # Each group of n_head/n_kv_head query heads shares one K/V head; the
+    # projection splits into separate wq/wkv leaves (the fused wqkv layout
+    # only exists for the square MHA case).
+    n_kv_head: Optional[int] = None
+    # Linear/LayerNorm biases (Llama ships none anywhere).
+    bias: bool = True
+    # Weight-tied LM head (reference train_harness.py:61-62). False adds a
+    # separate 'lm_head' (V, D) leaf (Llama unties).
+    tie_embeddings: bool = True
 
     @property
     def head_dim(self) -> int:
         assert self.n_embd % self.n_head == 0
         return self.n_embd // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head if self.n_kv_head is not None else self.n_head
+
+    @property
+    def mlp_dim(self) -> int:
+        return self.mlp_hidden if self.mlp_hidden is not None else 4 * self.n_embd
+
+    def __post_init__(self):
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"norm must be 'layernorm'|'rmsnorm', got {self.norm!r}")
+        if self.pos_embed not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embed must be 'learned'|'rope', got {self.pos_embed!r}"
+            )
+        if self.mlp_act not in ("gelu", "swiglu"):
+            raise ValueError(f"mlp_act must be 'gelu'|'swiglu', got {self.mlp_act!r}")
+        if self.n_kv_head is not None and self.n_head % self.n_kv_head != 0:
+            raise ValueError(
+                f"n_kv_head={self.n_kv_head} must divide n_head={self.n_head}"
+            )
+        if self.n_experts > 0 and self.mlp_act != "gelu":
+            raise ValueError(
+                "MoE blocks are defined for the dense-GELU MLP only "
+                "(n_experts > 0 with mlp_act='swiglu' is not supported)"
+            )
 
 
 def get_model_config(tier: str, seq_len: int, **overrides) -> TinyGPTConfig:
@@ -174,6 +233,13 @@ PARAM_AXIS_RULES: Dict[str, Tuple[Optional[str], ...]] = {
     # crosses a q/k/v boundary.
     "blocks/wqkv": ("layers", "embed", "qkv3", "heads"),
     "blocks/bqkv": ("layers", "qkv3", "heads"),
+    # GQA split projections (present instead of wqkv/bqkv when kv_heads <
+    # n_head): q keeps its own matrix; k/v stack on a 'kv2' axis so sharding
+    # 'kv_heads' never crosses the k/v boundary (same reasoning as qkv3).
+    "blocks/wq": ("layers", "embed", "heads"),
+    "blocks/bq": ("layers", "heads"),
+    "blocks/wkv": ("layers", "embed", "kv2", "kv_heads"),
+    "blocks/bkv": ("layers", "kv2", "kv_heads"),
     "blocks/wo": ("layers", "heads_merged", "embed"),
     "blocks/bo": ("layers", "embed"),
     "blocks/ln2_scale": ("layers", "embed"),
@@ -182,6 +248,11 @@ PARAM_AXIS_RULES: Dict[str, Tuple[Optional[str], ...]] = {
     "blocks/bfc": ("layers", "mlp"),
     "blocks/wproj": ("layers", "mlp", "embed"),
     "blocks/bproj": ("layers", "embed"),
+    # SwiGLU variant (present instead of wfc/bfc when mlp_act='swiglu'):
+    # gate and up matrices stack on a 'gate2' axis; wproj/bproj are shared
+    # with the dense path (same (layers, mlp, embed) shape).
+    "blocks/wgu": ("layers", "embed", "gate2", "mlp"),
+    "blocks/bgu": ("layers", "gate2", "mlp"),
     # MoE variant (present instead of wfc/bfc/wproj/bproj when n_experts > 0)
     "blocks/router": ("layers", "embed", "experts"),
     "blocks/moe_w1": ("layers", "experts", "embed", "mlp"),
@@ -190,6 +261,10 @@ PARAM_AXIS_RULES: Dict[str, Tuple[Optional[str], ...]] = {
     "blocks/moe_b2": ("layers", "experts", "embed"),
     "lnf_scale": ("embed",),
     "lnf_bias": ("embed",),
+    # Untied LM head (present when tie_embeddings=False): same logical axes
+    # as wte, so TP's vocab sharding (Megatron parallel softmax) applies to
+    # both ends identically.
+    "lm_head": ("vocab", "embed"),
 }
 
 
@@ -203,7 +278,13 @@ def init_params(config: TinyGPTConfig, key: jax.Array) -> Params:
     """
     c = config
     D, H, L, V, T = c.n_embd, c.n_head, c.n_layer, c.vocab_size, c.block_size
-    k = iter(jax.random.split(key, 8))
+    F, Hkv, Dh = c.mlp_dim, c.kv_heads, c.head_dim
+    # The legacy tree (fused qkv, tied head, learned positions) splits into
+    # exactly 8 keys — pinned so every published artifact's init (and loss
+    # trace) stays bit-reproducible. Family configs with extra leaves use a
+    # wider split; they are new surface with no reproduction constraint.
+    legacy = Hkv == H and c.tie_embeddings and c.pos_embed == "learned"
+    k = iter(jax.random.split(key, 8 if legacy else 12))
 
     def normal(key, shape):
         return (0.02 * jax.random.normal(key, shape)).astype(c.param_dtype)
@@ -211,39 +292,55 @@ def init_params(config: TinyGPTConfig, key: jax.Array) -> Params:
     zeros = lambda shape: jnp.zeros(shape, c.param_dtype)
     ones = lambda shape: jnp.ones(shape, c.param_dtype)
 
-    blocks = {
-        "ln1_scale": ones((L, D)),
-        "ln1_bias": zeros((L, D)),
-        "wqkv": normal(next(k), (L, D, 3, D)),
-        "bqkv": zeros((L, 3, D)),
-        "wo": normal(next(k), (L, D, D)),
-        "bo": zeros((L, D)),
-        "ln2_scale": ones((L, D)),
-        "ln2_bias": zeros((L, D)),
-    }
+    blocks = {"ln1_scale": ones((L, D)), "ln2_scale": ones((L, D))}
+    if c.norm == "layernorm":
+        blocks.update(ln1_bias=zeros((L, D)), ln2_bias=zeros((L, D)))
+    if Hkv == H:
+        blocks["wqkv"] = normal(next(k), (L, D, 3, D))
+        if c.bias:
+            blocks["bqkv"] = zeros((L, 3, D))
+    else:
+        blocks["wq"] = normal(next(k), (L, D, H * Dh))
+        blocks["wkv"] = normal(next(k), (L, D, 2, Hkv * Dh))
+        if c.bias:
+            blocks["bq"] = zeros((L, H * Dh))
+            blocks["bkv"] = zeros((L, 2, Hkv * Dh))
+    blocks["wo"] = normal(next(k), (L, D, D))
+    if c.bias:
+        blocks["bo"] = zeros((L, D))
     if c.n_experts > 0:
         E = c.n_experts
         blocks.update(
             router=normal(next(k), (L, D, E)),
-            moe_w1=normal(next(k), (L, E, D, 4 * D)),
-            moe_b1=zeros((L, E, 4 * D)),
-            moe_w2=normal(next(k), (L, E, 4 * D, D)),
+            moe_w1=normal(next(k), (L, E, D, F)),
+            moe_b1=zeros((L, E, F)),
+            moe_w2=normal(next(k), (L, E, F, D)),
             moe_b2=zeros((L, E, D)),
         )
+    elif c.mlp_act == "swiglu":
+        blocks["wgu"] = normal(next(k), (L, D, 2, F))
+        blocks["wproj"] = normal(next(k), (L, F, D))
+        if c.bias:
+            blocks["bgu"] = zeros((L, 2, F))
+            blocks["bproj"] = zeros((L, D))
     else:
-        blocks.update(
-            wfc=normal(next(k), (L, D, 4 * D)),
-            bfc=zeros((L, 4 * D)),
-            wproj=normal(next(k), (L, 4 * D, D)),
-            bproj=zeros((L, D)),
-        )
-    return {
+        blocks["wfc"] = normal(next(k), (L, D, F))
+        blocks["wproj"] = normal(next(k), (L, F, D))
+        if c.bias:
+            blocks["bfc"] = zeros((L, F))
+            blocks["bproj"] = zeros((L, D))
+    params = {
         "wte": normal(next(k), (V, D)),
-        "wpe": normal(next(k), (T, D)),
         "blocks": blocks,
         "lnf_scale": ones((D,)),
-        "lnf_bias": zeros((D,)),
     }
+    if c.pos_embed == "learned":
+        params["wpe"] = normal(next(k), (T, D))
+    if c.norm == "layernorm":
+        params["lnf_bias"] = zeros((D,))
+    if not c.tie_embeddings:
+        params["lm_head"] = normal(next(k), (V, D))
+    return params
 
 
 def count_params(params: Params) -> int:
@@ -257,6 +354,48 @@ def _layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float = 1e
     var = jnp.var(xf, axis=-1, keepdims=True)
     y = (xf - mean) * lax.rsqrt(var + eps)
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    # Llama RMSNorm: no mean subtraction, no bias; fp32 statistics (HF
+    # LlamaRMSNorm computes the rsqrt in fp32 and multiplies the scale in
+    # the input dtype — we keep the whole product fp32 before the downcast,
+    # which agrees to within bf16 rounding).
+    xf = x.astype(jnp.float32)
+    y = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _norm(
+    config: TinyGPTConfig, x: jax.Array, scale: jax.Array, bias: Optional[jax.Array]
+) -> jax.Array:
+    if config.norm == "rmsnorm":
+        return _rms_norm(x, scale, config.norm_eps)
+    return _layer_norm(x, scale, bias, config.norm_eps)
+
+
+def _rope(
+    x: jax.Array,  # (B, S, H, Dh)
+    positions: jax.Array,  # (S,) int32 global token positions
+    theta: float,
+) -> jax.Array:
+    """Rotary position embedding, HF-Llama rotate-half convention.
+
+    ``cos``/``sin`` are built over pairs (i, i + Dh/2) — x1 = first half,
+    x2 = second half, x' = x*cos + cat(-x2, x1)*sin — matching HF
+    ``apply_rotary_pos_emb`` exactly so the transformers parity test can
+    load identical weights. fp32 rotation math, cast back to x.dtype.
+    """
+    Dh = x.shape[-1]
+    half = Dh // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) * 2.0 / Dh))
+    freqs = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # (S, Dh/2)
+    cos = jnp.cos(freqs)[None, :, None, :]  # (1, S, 1, Dh/2)
+    sin = jnp.sin(freqs)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    out = jnp.concatenate((x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1)
+    return out.astype(x.dtype)
 
 
 def _dropout(x: jax.Array, rate: float, key: Optional[jax.Array], deterministic: bool) -> jax.Array:
@@ -399,42 +538,82 @@ def _block(
         keys = (keys[0], jax.random.fold_in(keys[1], lax.axis_index(c.seq_manual_axis)))
 
     # --- attention sublayer ---
-    h = _layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
-    qkv = (
-        jnp.einsum("bsd,dce->bsce", h, layer["wqkv"].astype(cd), preferred_element_type=jnp.float32)
-        .astype(cd)
-        + layer["bqkv"].astype(cd)
-    )  # (B, S, 3, D)
-    to_heads = lambda t: t.reshape(B, S, c.n_head, c.head_dim)
-    q, k, v = (to_heads(qkv[:, :, i]) for i in range(3))
+    h = _norm(c, x, layer["ln1_scale"], layer.get("ln1_bias"))
+    if "wqkv" in layer:  # fused MHA projection (kv_heads == n_head)
+        qkv = jnp.einsum(
+            "bsd,dce->bsce", h, layer["wqkv"].astype(cd), preferred_element_type=jnp.float32
+        ).astype(cd)
+        if "bqkv" in layer:
+            qkv = qkv + layer["bqkv"].astype(cd)
+        to_heads = lambda t: t.reshape(B, S, c.n_head, c.head_dim)
+        q, k, v = (to_heads(qkv[:, :, i]) for i in range(3))
+    else:  # GQA: separate q and stacked k/v projections
+        q = jnp.einsum(
+            "bsd,de->bse", h, layer["wq"].astype(cd), preferred_element_type=jnp.float32
+        ).astype(cd)
+        kv = jnp.einsum(
+            "bsd,dce->bsce", h, layer["wkv"].astype(cd), preferred_element_type=jnp.float32
+        ).astype(cd)
+        if "bq" in layer:
+            q = q + layer["bq"].astype(cd)
+            kv = kv + layer["bkv"].astype(cd)
+        q = q.reshape(B, S, c.n_head, c.head_dim)
+        k = kv[:, :, 0].reshape(B, S, c.kv_heads, c.head_dim)
+        v = kv[:, :, 1].reshape(B, S, c.kv_heads, c.head_dim)
+    if c.pos_embed == "rope":
+        # Global token positions; under a sequence-manual pipeline this
+        # shard holds positions [shard*S, shard*S + S) (same offset rule as
+        # the learned table's dynamic slice in embed()). The zigzag ring
+        # redistribution happens INSIDE ring_attention, after rotation, so
+        # the rotated rows travel with their tokens.
+        pos = jnp.arange(S, dtype=jnp.int32)
+        if c.seq_manual_axis is not None:
+            pos = pos + S * lax.axis_index(c.seq_manual_axis)
+        q = _rope(q, pos, c.rope_theta)
+        k = _rope(k, pos, c.rope_theta)
+    if c.kv_heads != c.n_head:
+        # Broadcast each K/V head to its query group. Consecutive-block
+        # repetition matches the TP layout: query-head shard j needs exactly
+        # kv-head shard j when the 'model' degree divides kv_heads.
+        rep = c.n_head // c.kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     attn = _attention(c, q, k, v, keys[0], deterministic)
     attn = attn.reshape(B, S, D)
-    attn = (
-        jnp.einsum("bsd,de->bse", attn, layer["wo"].astype(cd), preferred_element_type=jnp.float32)
-        .astype(cd)
-        + layer["bo"].astype(cd)
-    )
+    attn = jnp.einsum(
+        "bsd,de->bse", attn, layer["wo"].astype(cd), preferred_element_type=jnp.float32
+    ).astype(cd)
+    if "bo" in layer:
+        attn = attn + layer["bo"].astype(cd)
     x = x + attn
 
-    # --- MLP sublayer: dense D -> 4D -> GELU(exact) -> D -> dropout,
-    #     or the routed expert layer when n_experts > 0 ---
-    h = _layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    # --- MLP sublayer: dense D -> mlp_dim -> GELU(exact) -> D -> dropout,
+    #     SwiGLU (silu(gate)*up -> down), or the routed expert layer ---
+    h = _norm(c, x, layer["ln2_scale"], layer.get("ln2_bias"))
     if c.n_experts > 0:
         from .moe import moe_mlp
 
         h, aux = moe_mlp(c, layer, h, keys[1], deterministic)
         return x + h, aux
-    h = (
-        jnp.einsum("bsd,df->bsf", h, layer["wfc"].astype(cd), preferred_element_type=jnp.float32)
-        .astype(cd)
-        + layer["bfc"].astype(cd)
-    )
-    h = jax.nn.gelu(h, approximate=False)  # torch nn.GELU default is exact erf
-    h = (
-        jnp.einsum("bsf,fd->bsd", h, layer["wproj"].astype(cd), preferred_element_type=jnp.float32)
-        .astype(cd)
-        + layer["bproj"].astype(cd)
-    )
+    if c.mlp_act == "swiglu":
+        gu = jnp.einsum(
+            "bsd,dcf->bscf", h, layer["wgu"].astype(cd), preferred_element_type=jnp.float32
+        ).astype(cd)
+        if "bgu" in layer:
+            gu = gu + layer["bgu"].astype(cd)
+        h = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]
+    else:
+        h = jnp.einsum(
+            "bsd,df->bsf", h, layer["wfc"].astype(cd), preferred_element_type=jnp.float32
+        ).astype(cd)
+        if "bfc" in layer:
+            h = h + layer["bfc"].astype(cd)
+        h = jax.nn.gelu(h, approximate=False)  # torch nn.GELU default is exact erf
+    h = jnp.einsum(
+        "bsf,fd->bsd", h, layer["wproj"].astype(cd), preferred_element_type=jnp.float32
+    ).astype(cd)
+    if "bproj" in layer:
+        h = h + layer["bproj"].astype(cd)
     h = _dropout(h, c.dropout, keys[1], deterministic)
     return x + h, jnp.zeros((), jnp.float32)
 
@@ -458,12 +637,18 @@ def embed(
     tok = jnp.take(params["wte"], idx, axis=0)
     if c.seq_manual_axis is not None:
         shard = lax.axis_index(c.seq_manual_axis)
-        pos = lax.dynamic_slice_in_dim(params["wpe"], shard * S, S, axis=0)
         if dropout_key is not None:
             dropout_key = jax.random.fold_in(dropout_key, shard)
+    if c.pos_embed == "rope":
+        # Rotary positions are applied to q/k inside each block (_rope in
+        # _block); the residual stream carries no additive position signal.
+        x = tok.astype(c.compute_dtype)
     else:
-        pos = params["wpe"][:S]
-    x = (tok + pos[None, :, :]).astype(c.compute_dtype)
+        if c.seq_manual_axis is not None:
+            pos = lax.dynamic_slice_in_dim(params["wpe"], shard * S, S, axis=0)
+        else:
+            pos = params["wpe"][:S]
+        x = (tok + pos[None, :, :]).astype(c.compute_dtype)
     if dropout_key is not None and not deterministic:
         x = _dropout(x, c.dropout, dropout_key, deterministic)
     return x
@@ -544,13 +729,35 @@ def apply_blocks(
     return x, aux
 
 
+def embed_param_names(config: TinyGPTConfig) -> Tuple[str, ...]:
+    """Top-level leaves embed() reads — the pipeline schedules replicate
+    exactly these across stages (wpe only exists for learned positions)."""
+    return ("wte", "wpe") if config.pos_embed == "learned" else ("wte",)
+
+
+def head_param_names(config: TinyGPTConfig) -> Tuple[str, ...]:
+    """Top-level leaves head() reads (lnf_bias only for layernorm; the head
+    matrix is wte when tied, lm_head when untied)."""
+    names = ["lnf_scale"]
+    if config.norm == "layernorm":
+        names.append("lnf_bias")
+    names.append("wte" if config.tie_embeddings else "lm_head")
+    return tuple(names)
+
+
 def head(config: TinyGPTConfig, params: Params, x: jax.Array) -> jax.Array:
-    """Final LN + weight-tied LM head -> fp32 logits (B, S, V)."""
-    x = _layer_norm(x, params["lnf_scale"], params["lnf_bias"])
+    """Final norm + LM head -> fp32 logits (B, S, V).
+
+    The head matrix is ``wte`` when weight-tied (reference
+    train_harness.py:61-62) or the separate ``lm_head`` leaf when untied
+    (the Llama family) — same (V, D) layout and vocab-sharding either way.
+    """
+    x = _norm(config, x, params["lnf_scale"], params.get("lnf_bias"))
+    w = params["wte"] if config.tie_embeddings else params["lm_head"]
     return jnp.einsum(
         "bsd,vd->bsv",
         x,
-        params["wte"].astype(config.compute_dtype),
+        w.astype(config.compute_dtype),
         preferred_element_type=jnp.float32,
     )
 

@@ -374,8 +374,8 @@ def interleaved_loss_and_grads(
             config.router_aux_coef / (config.n_layer * n_micro) if moe else 0.0
         )
 
-        hp = {k: params[k] for k in ("lnf_scale", "lnf_bias", "wte")}
-        ep = {k: params[k] for k in ("wte", "wpe")}
+        hp = {k: params[k] for k in tinygpt.head_param_names(config)}
+        ep = {k: params[k] for k in tinygpt.embed_param_names(config)}
         # Pre-cast the head/embed params to device-varying so their vjps stay
         # collective-free inside the switch branches (an invariant primal
         # would make the transpose insert a psum there — deadlock inside
@@ -601,13 +601,10 @@ def interleaved_loss_and_grads(
             ) / (config.n_layer * n_micro)
         d_hp = jax.tree.map(lambda x: lax.psum(x, var_axes), d_hp)
         d_ep = jax.tree.map(lambda x: lax.psum(x, var_axes), d_ep)
-        grads = {
-            "blocks": d_blocks,
-            "wte": d_hp["wte"] + d_ep["wte"],
-            "wpe": d_ep["wpe"],
-            "lnf_scale": d_hp["lnf_scale"],
-            "lnf_bias": d_hp["lnf_bias"],
-        }
+        grads = {"blocks": d_blocks}
+        for _dtree in (d_hp, d_ep):  # wte appears in both when tied: sum
+            for _k, _v in _dtree.items():
+                grads[_k] = grads[_k] + _v if _k in grads else _v
         return loss, grads
 
     specs = pipeline_param_specs(params, mesh)

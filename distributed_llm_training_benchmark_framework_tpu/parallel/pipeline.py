@@ -260,8 +260,8 @@ def pipeline_loss_and_grads_1f1b(
         loss_sum = jnp.zeros((), jnp.float32)
 
         d_blocks = jax.tree.map(jnp.zeros_like, blocks)
-        hp = {k: params[k] for k in ("lnf_scale", "lnf_bias", "wte")}
-        ep = {k: params[k] for k in ("wte", "wpe")}
+        hp = {k: params[k] for k in tinygpt.head_param_names(config)}
+        ep = {k: params[k] for k in tinygpt.embed_param_names(config)}
         d_ep = jax.tree.map(jnp.zeros_like, ep)
 
         # Head strategy mirrors pipeline_loss_fn: on TPU a lax.cond skips the
@@ -445,13 +445,10 @@ def pipeline_loss_and_grads_1f1b(
         # implicit broadcast into a psum. d_ep likewise came back invariant
         # through the embed's explicit pcast. No further reduction — it
         # would double-count.
-        grads = {
-            "blocks": d_blocks,
-            "wte": d_hp["wte"] + d_ep["wte"],
-            "wpe": d_ep["wpe"],
-            "lnf_scale": d_hp["lnf_scale"],
-            "lnf_bias": d_hp["lnf_bias"],
-        }
+        grads = {"blocks": d_blocks}
+        for _dtree in (d_hp, d_ep):  # wte appears in both when tied: sum
+            for _k, _v in _dtree.items():
+                grads[_k] = grads[_k] + _v if _k in grads else _v
         return loss, grads
 
     specs = pipeline_param_specs(params, mesh)

@@ -573,11 +573,22 @@ def offload_update_and_apply(
 # GSPMD derives the collectives from the sharding).
 _TP_RULES = {
     "wte": (0,),        # vocab
+    "lm_head": (0,),    # untied head: vocab-sharded like wte
     "blocks/wqkv": (3,),  # per-head output features
     "blocks/bqkv": (2,),
+    # GQA split projections: column-parallel q and k/v (the consecutive-block
+    # kv repeat in the model keeps each query-head shard paired with its own
+    # kv-head shard as long as the 'model' degree divides kv_heads)
+    "blocks/wq": (2,),
+    "blocks/bq": (1,),
+    "blocks/wkv": (3,),
+    "blocks/bkv": (2,),
     "blocks/wo": (1,),  # row-parallel input (merged heads)
     "blocks/wfc": (2,),  # column-parallel output
     "blocks/bfc": (1,),
+    # SwiGLU gate/up stack: column-parallel output features
+    "blocks/wgu": (3,),
+    "blocks/bgu": (2,),
     "blocks/wproj": (1,),  # row-parallel input
     # MoE experts: column-parallel w1, row-parallel w2 inside each expert
     "blocks/moe_w1": (3,),
@@ -648,7 +659,7 @@ def param_partition_specs(params: Params, mesh: Mesh, shard: bool) -> Params:
                 s[ax] = "expert"
         if n_model > 1:
             for ax in _TP_RULES.get(name, ()):
-                if name == "wte" and n_pipe > 1:
+                if name in ("wte", "lm_head") and n_pipe > 1:
                     # Pipeline runs keep the tied embedding replicated over
                     # 'model': the schedule already replicates embed/head
                     # across stages (every stage computes them for schedule

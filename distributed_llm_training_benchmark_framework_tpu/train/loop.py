@@ -254,6 +254,13 @@ def _run_benchmark_impl(
                 f"{refusal}\nPass --skip-memory-check to attempt the run anyway."
             )
 
+    if offload_dpu_start_step < 0:
+        # A negative value would skip every refusal below (the block gates
+        # on > 0) while still being recorded as run identity in the result
+        # row — the silent-A/B-corruption class those refusals exist for.
+        raise ValueError(
+            f"--offload-dpu-start-step must be >= 0, got {offload_dpu_start_step}"
+        )
     if offload_dpu_start_step > 0:
         # Delayed-update staleness measurably slows the STEEP early-descent
         # phase (PERFORMANCE.md §13 — DeepSpeed gates its DPU behind warmup
@@ -291,26 +298,27 @@ def _run_benchmark_impl(
             )
 
     t_init = time.perf_counter()
+    dpu_serial_phase = strategy.offload_delayed_update and offload_dpu_start_step > 0
+    # With a serial pre-phase, the DPU state is created ABSTRACT (zero
+    # allocation): only its step_fn and the pending slot's layout are
+    # needed until the serial->delayed transition — the memory-tight
+    # offload arm never holds two copies of params/masters/moments, and
+    # startup skips one full init compile.
     state = create_train_state(
         model_config, strategy, mesh, seed=seed, grad_accum=grad_accum,
         from_table=True, global_micro=global_micro, seq_len=seq_len,
         pipeline_schedule=pipeline_schedule, virtual_stages=virtual_stages,
+        abstract_init=dpu_serial_phase,
     )
     serial_state = None
     pending_template = None
-    if strategy.offload_delayed_update and offload_dpu_start_step > 0:
+    if dpu_serial_phase:
         import dataclasses as _dc
 
-        # Keep only the DPU state's step_fn + the pending slot's layout;
-        # free its initial arrays BEFORE building the serial state, so the
-        # memory-tight offload arm never holds two full copies of
-        # params/masters/moments (the serial phase re-creates them).
         pending_template = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
             state.opt_state[2],
         )
-        for leaf in jax.tree.leaves((state.params, state.opt_state)):
-            leaf.delete()
         serial_state = create_train_state(
             model_config,
             _dc.replace(strategy, offload_delayed_update=False),

@@ -401,12 +401,20 @@ def create_train_state(
     seq_len: int = 0,
     pipeline_schedule: str = "gpipe",
     virtual_stages: int = 2,
+    abstract_init: bool = False,
 ) -> TrainState:
     """Initialize params + optimizer state directly into their target shardings.
 
     Init is jitted with ``out_shardings`` so tier-B params materialize sharded
     across HBM — no single host/device ever holds the full replicated tree
     (the TPU analogue of FSDP's deferred/sharded init).
+
+    ``abstract_init=True`` allocates NOTHING: params/opt_state come back as
+    ``ShapeDtypeStruct``s carrying their target shardings. Used by the
+    ``--offload-dpu-start-step`` serial phase, which only needs the delayed
+    state's step_fn and the pending slot's layout until the transition —
+    materializing the multi-GB host master/moment tree twice (once to read
+    its shapes, once for real) would double the startup bill for nothing.
     """
     cfg = _resolve_model_config(model_config, strategy, mesh)
     optimizer = strat.make_optimizer(strategy)
@@ -439,15 +447,28 @@ def create_train_state(
         optimizer, params_shape, param_specs, mesh, shard=strategy.shard_opt_state
     )
 
-    with mesh:
-        params = jax.jit(
-            init_fn,
-            out_shardings=strat.named(mesh, param_specs),
-        )(jax.random.key(seed))
-        opt_state = jax.jit(
-            optimizer.init,
-            out_shardings=strat.opt_state_shardings(mesh, opt_specs, strategy),
-        )(params)
+    if abstract_init:
+        def _abstract(shapes, shardings):
+            return jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+                shapes, shardings,
+            )
+
+        params = _abstract(params_shape, strat.named(mesh, param_specs))
+        opt_state = _abstract(
+            jax.eval_shape(optimizer.init, params_shape),
+            strat.opt_state_shardings(mesh, opt_specs, strategy),
+        )
+    else:
+        with mesh:
+            params = jax.jit(
+                init_fn,
+                out_shardings=strat.named(mesh, param_specs),
+            )(jax.random.key(seed))
+            opt_state = jax.jit(
+                optimizer.init,
+                out_shardings=strat.opt_state_shardings(mesh, opt_specs, strategy),
+            )(params)
 
     step_fn, aot_compile = make_train_step(
         model_config,

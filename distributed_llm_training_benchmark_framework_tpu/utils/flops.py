@@ -96,12 +96,27 @@ def tokens_per_dollar(
 
 
 def forward_flops_per_token(config) -> float:
-    """Analytic forward-pass FLOPs per token for a TinyGPTConfig."""
+    """Analytic forward-pass FLOPs per token.
+
+    Generalized over the architecture-family knobs (models.tinygpt): GQA
+    shrinks the K/V projection to ``2*kv_heads*head_dim`` columns, SwiGLU's
+    MLP runs three matrices (``6*D*F`` vs GELU's ``4*D*F``), and RoPE adds
+    no matmul FLOPs (elementwise rotation — not counted, per the
+    PaLM/Chinchilla convention). The LM head term is ``2*D*V`` tied or
+    untied alike. Defaults reproduce the original TinyGPT accounting
+    exactly (kv=H, F=4D, gelu -> 8*D^2 attention projections + 16*D^2 MLP).
+    """
     D, L, V, S = config.n_embd, config.n_layer, config.vocab_size, config.block_size
+    H = config.n_head
+    Hkv = getattr(config, "kv_heads", H) or H
+    F = getattr(config, "mlp_dim", 4 * D) or 4 * D
+    Dh = D // H
     if getattr(config, "n_experts", 0) > 0:
-        mlp = 2 * config.expert_top_k * (8 * D * D) + 2 * D * config.n_experts
+        mlp = 2 * config.expert_top_k * (2 * D * F) + 2 * D * config.n_experts
+    elif getattr(config, "mlp_act", "gelu") == "swiglu":
+        mlp = 2 * (2 * D * F + F * D)  # gate + up + down
     else:
-        mlp = 16 * D * D
+        mlp = 2 * (D * F + F * D)
     # Causal masking halves the score-matrix work: the flash/ring kernels
     # skip fully-masked tiles (ops/flash_attention.py `live`), so charging
     # full S would overstate MFU on --causal runs by up to ~1.5x at 16K.
@@ -110,10 +125,11 @@ def forward_flops_per_token(config) -> float:
     # stay comparable across block sizes.
     attn_tokens = S / 2 if getattr(config, "causal", False) else S
     per_layer = (
-        6 * D * D  # QKV projection
-        + 2 * D * D  # attention output projection
+        2 * D * (H * Dh)  # Q projection
+        + 2 * D * (2 * Hkv * Dh)  # K/V projections
+        + 2 * (H * Dh) * D  # attention output projection
         + mlp
-        + 4 * attn_tokens * D  # QK^T and probs@V
+        + 4 * attn_tokens * (H * Dh)  # QK^T and probs@V
     )
     return float(L * per_layer + 2 * D * V)
 

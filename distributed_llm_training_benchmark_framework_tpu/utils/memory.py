@@ -160,7 +160,14 @@ def estimate_hbm(
     tp = mesh.shape.get("model", 1)
     pp = mesh.shape.get("pipe", 1)
     cbytes = jnp_itemsize(cfg.compute_dtype)
-    dense_per_layer = 14 * B * S * D * cbytes  # ln/qkv/attn-out/mlp residuals
+    # ln/qkv/attn-out residuals (~10·BSD) + the MLP hidden tensors: F/D
+    # widths of it for GELU, 2F/D (gate+up) for SwiGLU. Default geometry
+    # (F=4D, gelu) reproduces the original 14·BSD coefficient. GQA's k/v
+    # are repeated to full H before attention (models.tinygpt), so no
+    # activation credit is taken for kv_heads < n_head.
+    F = getattr(cfg, "mlp_dim", 4 * D) or 4 * D
+    mlp_widths = (2 if getattr(cfg, "mlp_act", "gelu") == "swiglu" else 1) * F / D
+    dense_per_layer = int((10 + mlp_widths) * B * S * D) * cbytes
     # Megatron TP shards the head and MLP activations.
     dense_per_layer = dense_per_layer // max(tp, 1)
     if cfg.attention_impl == "reference":

@@ -159,3 +159,26 @@ def test_strategy_config_files_load():
         names.add(sc.name)
         assert sc.learning_rate > 0
     assert {"ddp", "fsdp", "zero2", "zero3"} <= names
+
+
+def test_abstract_init_allocates_nothing(eight_devices):
+    """create_train_state(abstract_init=True) returns ShapeDtypeStructs
+    carrying the same shardings the materialized state would have — the
+    zero-allocation template path --offload-dpu-start-step's serial phase
+    uses to learn the delayed layout without paying two full inits."""
+    cfg = get_model_config("S", 64, dropout=0.0)
+    mesh = make_mesh((8,), ("data",), devices=jax.devices()[:8])
+    abstract = create_train_state(
+        cfg, get_strategy("zero2"), mesh, seed=42, abstract_init=True
+    )
+    leaves = jax.tree.leaves((abstract.params, abstract.opt_state))
+    assert leaves and all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+
+    real = create_train_state(cfg, get_strategy("zero2"), mesh, seed=42)
+    a_flat = jax.tree.leaves((abstract.params, abstract.opt_state))
+    r_flat = jax.tree.leaves((real.params, real.opt_state))
+    assert len(a_flat) == len(r_flat)
+    for a, r in zip(a_flat, r_flat):
+        assert a.shape == r.shape and a.dtype == r.dtype
+        assert a.sharding.spec == r.sharding.spec, (a, r.sharding)
+    assert abstract.n_params == real.n_params
