@@ -54,8 +54,9 @@ def load_results(results_dir: str) -> pd.DataFrame:
     # the run identity key.
     key = [
         c for c in (
-            "strategy", "world_size", "seq_len", "tier", "rank",
-            "per_device_batch", "grad_accum", "steps", "attention_impl",
+            "strategy", "world_size", "seq_len", "tier", "model_family",
+            "rank", "per_device_batch", "grad_accum", "steps",
+            "attention_impl",
             # Composition axes: a pipeline/TP/SP/MoE/bf16 arm is a DIFFERENT
             # run from the baseline with the same batch geometry — without
             # these in the key, a composition suite sharing RESULTS_DIR with
@@ -81,7 +82,8 @@ def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
     """
     group_cols = ["strategy", "seq_len"] + [
         c for c in (
-            "tier", "per_device_batch", "grad_accum", "attention_impl",
+            "tier", "model_family", "per_device_batch", "grad_accum",
+            "attention_impl",
             "tensor_parallel", "sequence_parallel", "pipeline_parallel",
             "pipeline_schedule", "virtual_stages", "expert_parallel",
             "n_experts", "param_dtype", "offload_opt_state",

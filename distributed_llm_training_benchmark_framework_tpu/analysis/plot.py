@@ -57,10 +57,19 @@ def _color_for(strategy: str, i: int) -> str:
 def _seq_key_cols(df: pd.DataFrame) -> List[str]:
     """Line-grouping key for the vs-sequence-length figures: a mixed results
     dir holds several rows per (strategy, seq_len) — one per attention impl /
-    world size — and merging them into one line would draw vertical zigzags."""
+    world size / model family / composition arm — and merging them into one
+    line would draw vertical zigzags. Every identity axis that actually
+    varies in the frame joins the key (and the line label)."""
     return ["strategy"] + [
-        c for c in ("attention_impl", "world_size")
-        if c in df.columns and df[c].nunique() > 1
+        c for c in (
+            "attention_impl", "world_size", "tier", "model_family",
+            "causal", "ring_zigzag", "n_experts", "param_dtype",
+            "offload_opt_state", "offload_delayed_update",
+            "offload_dpu_start_step", "tensor_parallel", "sequence_parallel",
+            "pipeline_parallel", "pipeline_schedule", "virtual_stages",
+            "expert_parallel",
+        )
+        if c in df.columns and df[c].nunique(dropna=False) > 1
     ]
 
 

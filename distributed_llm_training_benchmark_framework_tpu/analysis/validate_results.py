@@ -82,6 +82,10 @@ MFU_FLOOR_MOE8 = 26.0
 # count — attention work halves under the mask, so the denominator is not
 # the bidirectional rows').
 MFU_FLOOR_CAUSAL_2K = 31.0
+# The published Llama-family 2K row (models.llama tier A: head_dim 128,
+# GQA, SwiGLU, no dropout; measured 45.2% — the wide-head shape clears the
+# D=64 score-tile wall documented in PERFORMANCE.md §15/§16).
+MFU_FLOOR_LLAMA_2K = 42.0
 # Routing-health envelope for MoE rows: the capacity discipline drops SOME
 # assignments (cf 1.25 < top-k worst case), but beyond this bound routing
 # has collapsed onto a few experts (or capacity accounting broke).
@@ -145,7 +149,7 @@ def validate_result(r: dict, name: str) -> List[str]:
     # Shared base: the published-arm geometry minus the causal/offload
     # axes (each floor below adds its own) — one predicate to update when
     # e.g. a v6 device kind joins the published set.
-    base_geometry = (
+    family_geometry = (
         r.get("tier") == "A"
         and r.get("world_size") == 1
         and "v5" in str(r.get("device_kind", ""))
@@ -154,6 +158,20 @@ def validate_result(r: dict, name: str) -> List[str]:
         and not r.get("offload_opt_state")
         and r.get("mfu_pct", 0) > 0
     )
+    base_geometry = (
+        family_geometry and r.get("model_family", "tinygpt") == "tinygpt"
+    )
+    if (
+        family_geometry
+        and r.get("model_family") == "llama"
+        and r.get("seq_len") == 2048
+        and r.get("n_experts", 0) == 0
+    ):
+        _check(
+            r["mfu_pct"] >= MFU_FLOOR_LLAMA_2K, name,
+            f"mfu_pct={r['mfu_pct']:.1f}% below the {MFU_FLOOR_LLAMA_2K}% "
+            "llama-family floor (published-row regression)", f,
+        )
     published_geometry = base_geometry and not r.get("causal")
     floor = MFU_FLOORS_TIER_A.get(r.get("seq_len"))
     if floor is not None and published_geometry and r.get("n_experts", 0) == 0:

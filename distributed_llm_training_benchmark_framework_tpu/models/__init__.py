@@ -7,10 +7,12 @@ from .tinygpt import (
     count_params,
     PARAM_AXIS_RULES,
 )
+from .llama import get_llama_config
 
 __all__ = [
     "TinyGPTConfig",
     "get_model_config",
+    "get_llama_config",
     "init_params",
     "forward",
     "loss_fn",

@@ -1,0 +1,72 @@
+"""Llama model family — tier table over the shared functional transformer.
+
+The reference framework benchmarks exactly one architecture (its TinyGPT,
+reference ``benchmarking/train_harness.py:36-131``); a second family is
+beyond-parity surface. Rather than a parallel implementation, the family is a
+CONFIGURATION of the same pytree transformer (``models.tinygpt``): RMSNorm,
+rotary position embeddings, SwiGLU MLP, grouped-query attention, no biases,
+untied LM head, causal masking — each an orthogonal config knob whose
+numerics are pinned against HuggingFace ``LlamaForCausalLM`` by
+``tests/test_llama_family.py``. Every strategy arm, pipeline schedule,
+attention kernel, remat policy and the memory/FLOPs accounting work on the
+family unchanged, because they only ever consumed the config and the leaf
+names.
+
+Why the tiers use head_dim 128 (vs TinyGPT's 64): the measured MXU wall
+(docs/PERFORMANCE.md §15) — at D_head=64 the flash kernel's score-tile
+arithmetic intensity caps the attention matmuls at ~22-26% of peak, while
+the iso-FLOP D_head=128 probe reached ~35%. Llama-family shapes are how
+real models buy back that headroom, so the family doubles as the
+benchmark's wide-head MFU arm.
+
+Parameter budgets (untied embeddings; SwiGLU F ≈ 8/3·D rounded to 256):
+tier A ≈ 255M — comparable to TinyGPT tier A's 236M; tier B ≈ 1.62B —
+comparable to tier B's 1.68B. Tier S is the CPU test tier.
+"""
+
+from __future__ import annotations
+
+from .tinygpt import TinyGPTConfig
+
+# (vocab, d_model, n_head, n_kv_head, n_layer, mlp_hidden). head_dim is
+# d_model / n_head = 128 for A/B (the MXU-width tier design), 64 for S.
+_TIERS = {
+    # ~255M params. 8 query heads of 128; 4 KV heads (GQA 2:1).
+    "A": dict(vocab_size=32000, n_embd=1024, n_head=8, n_kv_head=4,
+              n_layer=16, mlp_hidden=2816),
+    # ~1.62B params. 16 query heads of 128; 8 KV heads.
+    "B": dict(vocab_size=32000, n_embd=2048, n_head=16, n_kv_head=8,
+              n_layer=32, mlp_hidden=5632),
+    # Tiny CPU/test tier (head_dim 64 — small enough for 8-device meshes).
+    "S": dict(vocab_size=512, n_embd=128, n_head=2, n_kv_head=1,
+              n_layer=2, mlp_hidden=352),
+}
+
+
+def get_llama_config(tier: str, seq_len: int, **overrides) -> TinyGPTConfig:
+    """Llama-family tier table (same call shape as ``get_model_config``).
+
+    ``block_size = seq_len`` follows the reference convention
+    (train_harness.py:168,176) — with RoPE there is no positional table to
+    size, but block_size still bounds the benchmark geometry checks.
+    """
+    if tier not in _TIERS:
+        raise ValueError(
+            f"Unknown llama tier: {tier!r} (expected one of {sorted(_TIERS)})"
+        )
+    kw = dict(_TIERS[tier])
+    kw.update(
+        block_size=seq_len,
+        causal=True,            # the family is causal-LM by construction
+        norm="rmsnorm",
+        pos_embed="rope",
+        mlp_act="swiglu",
+        bias=False,
+        tie_embeddings=False,
+        # Llama-family pretraining runs without dropout (HF LlamaConfig
+        # attention_dropout defaults to 0.0) — unlike the reference TinyGPT's
+        # 0.1. Overridable like every other knob (--dropout).
+        dropout=0.0,
+    )
+    kw.update(overrides)
+    return TinyGPTConfig(**kw)

@@ -84,6 +84,12 @@ def build_parser() -> argparse.ArgumentParser:
     # Model & data
     p.add_argument("--tier", type=str, required=True, choices=["A", "B", "S"],
                    help="Model tier (S = tiny CPU/smoke tier, ours)")
+    p.add_argument("--model-family", choices=["tinygpt", "llama"],
+                   default="tinygpt",
+                   help="Model architecture family: 'tinygpt' (reference "
+                        "parity: LayerNorm/learned-pos/GELU, maskless by "
+                        "default) or 'llama' (RMSNorm/RoPE/SwiGLU/GQA, "
+                        "causal, head_dim-128 tiers — models.llama)")
     p.add_argument("--seq-len", type=int, required=True)
     p.add_argument("--synthetic", action="store_true", default=True,
                    help="Use synthetic data (always true; flag kept live+honest)")
@@ -274,6 +280,7 @@ def main(argv=None) -> int:
         run_benchmark(
             strategy=strategy,
             tier=args.tier,
+            model_family=args.model_family,
             seq_len=args.seq_len,
             steps=args.steps,
             warmup_steps=args.warmup_steps,

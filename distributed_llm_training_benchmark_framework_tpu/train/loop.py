@@ -61,6 +61,7 @@ def _run_benchmark_impl(
     strategy: StrategyConfig,
     tier: str,
     seq_len: int,
+    model_family: str = "tinygpt",
     steps: int,
     warmup_steps: int,
     per_device_batch: int,
@@ -186,9 +187,22 @@ def _run_benchmark_impl(
         overrides["scan_layers"] = False
     elif layer_loop != "scan":
         raise ValueError(f"unknown layer_loop {layer_loop!r}")
-    model_config = get_model_config(
-        tier, seq_len, attention_impl=attention_impl, **overrides
-    )
+    if model_family == "llama":
+        from ..models.llama import get_llama_config
+
+        # The family is causal by construction; --causal is redundant but
+        # harmless (same value), and every other override applies on top.
+        model_config = get_llama_config(
+            tier, seq_len, attention_impl=attention_impl, **overrides
+        )
+    elif model_family == "tinygpt":
+        model_config = get_model_config(
+            tier, seq_len, attention_impl=attention_impl, **overrides
+        )
+    else:
+        raise ValueError(
+            f"unknown model_family {model_family!r} (expected 'tinygpt' or 'llama')"
+        )
     if is_main:
         print(f"Strategy: {strategy.describe()}")
         print(
@@ -562,6 +576,7 @@ def _run_benchmark_impl(
             else "on" if model_config.ring_zigzag else "off"
         ),
         expert_overflow_pct=expert_overflow_pct,
+        model_family=model_family,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

@@ -230,14 +230,21 @@ class BenchmarkResult:
     # .moe_overflow_fraction diagnostic); None for dense runs or when the
     # diagnostic could not run under the run's sharding.
     expert_overflow_pct: Optional[float] = None
+    # Model family ('tinygpt' = reference parity architecture; 'llama' =
+    # the RMSNorm/RoPE/SwiGLU/GQA family, models.llama) — run identity: a
+    # llama tier-A row is a different model than a tinygpt tier-A row.
+    model_family: str = "tinygpt"
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
 
     def result_filename(self) -> str:
+        # Non-default families suffix the name; the tinygpt filename stays
+        # bit-compatible with the reference scheme (train_harness.py:443-446).
+        fam = "" if self.model_family == "tinygpt" else f"_{self.model_family}"
         return (
             f"result_{self.strategy}_ws{self.world_size}"
-            f"_seq{self.seq_len}_tier{self.tier}.json"
+            f"_seq{self.seq_len}_tier{self.tier}{fam}.json"
         )
 
 
@@ -277,6 +284,7 @@ def compute_result(
     causal: bool = False,
     ring_zigzag: str = "auto",
     expert_overflow_pct: Optional[float] = None,
+    model_family: str = "tinygpt",
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
@@ -362,6 +370,7 @@ def compute_result(
         causal=causal,
         ring_zigzag=ring_zigzag,
         expert_overflow_pct=expert_overflow_pct,
+        model_family=model_family,
     )
 
 

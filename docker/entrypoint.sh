@@ -60,6 +60,7 @@ export OFFLOAD_OPT_STATE="${OFFLOAD_OPT_STATE:-0}"
 export OFFLOAD_DELAYED_UPDATE="${OFFLOAD_DELAYED_UPDATE:-0}"
 export OFFLOAD_DPU_START_STEP="${OFFLOAD_DPU_START_STEP:-0}"
 export CAUSAL="${CAUSAL:-0}"
+export MODEL_FAMILY="${MODEL_FAMILY:-tinygpt}"
 export RING_ZIGZAG="${RING_ZIGZAG:-auto}"
 
 echo "Config:"
@@ -101,6 +102,8 @@ if [ "${NUM_EXPERTS}" != "0" ]; then
   ARGS="${ARGS} --num-experts ${NUM_EXPERTS}"; fi
 if [ -n "${PARAM_DTYPE}" ]; then
   ARGS="${ARGS} --param-dtype ${PARAM_DTYPE}"; fi
+if [ "${MODEL_FAMILY}" != "tinygpt" ]; then
+  ARGS="${ARGS} --model-family ${MODEL_FAMILY}"; fi
 if [ "${OFFLOAD_OPT_STATE}" = "1" ]; then
   ARGS="${ARGS} --offload-opt-state"; fi
 if [ "${OFFLOAD_DELAYED_UPDATE}" = "1" ]; then

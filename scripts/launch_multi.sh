@@ -27,6 +27,7 @@ VIRTUAL_STAGES=2
 EXPERT_PARALLEL=1
 NUM_EXPERTS=0
 PARAM_DTYPE=""
+MODEL_FAMILY="tinygpt"
 OFFLOAD_OPT_STATE=0
 OFFLOAD_DELAYED_UPDATE=0
 OFFLOAD_DPU_START_STEP=0
@@ -58,6 +59,7 @@ while [ $# -gt 0 ]; do
     --expert-parallel) EXPERT_PARALLEL="$2"; shift 2 ;;
     --num-experts) NUM_EXPERTS="$2"; shift 2 ;;
     --param-dtype) PARAM_DTYPE="$2"; shift 2 ;;
+    --model-family) MODEL_FAMILY="$2"; shift 2 ;;
     --offload-opt-state) OFFLOAD_OPT_STATE=1; shift 1 ;;
     --offload-delayed-update) OFFLOAD_DELAYED_UPDATE=1; shift 1 ;;
     --offload-dpu-start-step) OFFLOAD_DPU_START_STEP="$2"; shift 2 ;;
@@ -103,6 +105,7 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{EXPERT_PARALLEL}}|$EXPERT_PARALLEL|g" \
     -e "s|{{NUM_EXPERTS}}|$NUM_EXPERTS|g" \
     -e "s|{{PARAM_DTYPE}}|$PARAM_DTYPE|g" \
+    -e "s|{{MODEL_FAMILY}}|$MODEL_FAMILY|g" \
     -e "s|{{OFFLOAD_OPT_STATE}}|$OFFLOAD_OPT_STATE|g" \
     -e "s|{{OFFLOAD_DELAYED_UPDATE}}|$OFFLOAD_DELAYED_UPDATE|g" \
     -e "s|{{OFFLOAD_DPU_START_STEP}}|$OFFLOAD_DPU_START_STEP|g" \

@@ -204,6 +204,7 @@ sp2-ring-causal-nozz|zero2|--sequence-parallel 2 --attention ring --causal --rin
 sp2-ulysses|zero2|--sequence-parallel 2 --attention ulysses|--sequence-parallel 2 --attention ulysses
 moe-ep2|zero2|--num-experts 4 --expert-parallel 2|--num-experts 4 --expert-parallel 2
 moe8-ep2|zero2|--num-experts 8 --expert-parallel 2|--num-experts 8 --expert-parallel 2
+llama-tp2|fsdp|--model-family llama --tensor-parallel 2|--model-family llama --tensor-parallel 2
 "
   echo ""
   echo "=== Composition arms (ws=$WS_MAX) ==="

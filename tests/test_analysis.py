@@ -233,6 +233,27 @@ def test_validate_results_mfu_floor(tmp_path):
     assert not any("floor" in f for f in failures)
 
 
+def test_validate_results_llama_mfu_floor(tmp_path):
+    """The llama-family 2K row has its own floor (42%), keyed on
+    model_family — a degraded llama row fails; the same MFU is fine for a
+    tinygpt row (whose 2K floor is 36%) and a tinygpt row never trips the
+    llama floor."""
+    degraded = result(
+        strategy="zero2", ws=1, seq=2048, attention_impl="flash",
+        device_kind="TPU v5 lite", mfu_pct=39.0, sync_every=10,
+    )
+    write_results(tmp_path, [dict(degraded, model_family="llama", causal=True)])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert any("llama-family floor" in f for f in failures)
+    write_results(tmp_path, [dict(degraded, model_family="tinygpt")])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("floor" in f for f in failures)
+    write_results(tmp_path, [dict(degraded, model_family="llama",
+                                  causal=True, mfu_pct=45.2)])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("floor" in f for f in failures)
+
+
 def test_validate_results_published_artifacts_pass():
     """The committed example_output must satisfy its own envelopes —
     including the new MFU floors against the published rows."""

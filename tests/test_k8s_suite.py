@@ -90,6 +90,8 @@ if "logs" in argv:
         result.update(expert_parallel=2, n_experts=4)
     elif comp == "moe8-ep2":
         result.update(expert_parallel=2, n_experts=8)
+    elif comp == "llama-tp2":
+        result.update(tensor_parallel=2, model_family="llama", causal=True)
     print("boot log line")
     print("BENCHMARK_RESULT_JSON_START")
     print(json.dumps(result, indent=2))
@@ -207,6 +209,7 @@ COMP_JOBS = {
     "tpu-bench-zero2-ws4-sp2-ulysses",
     "tpu-bench-zero2-ws4-moe-ep2",
     "tpu-bench-zero2-ws4-moe8-ep2",
+    "tpu-bench-fsdp-ws4-llama-tp2",
 }
 
 
@@ -239,10 +242,10 @@ def roster_run(tmp_path_factory):
     return proc, tmp, results
 
 
-def test_roster_exits_zero_with_ten_arms(roster_run):
+def test_roster_exits_zero_with_eleven_arms(roster_run):
     proc, _, _ = roster_run
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
-    assert "10 passed, 0 failed" in proc.stdout
+    assert "11 passed, 0 failed" in proc.stdout
 
 
 def test_roster_job_names_and_manifest_env(roster_run):
@@ -264,6 +267,9 @@ def test_roster_job_names_and_manifest_env(roster_run):
     assert 'name: SEQUENCE_PARALLEL\n              value: "2"' in ring
     assert 'name: ATTENTION\n              value: "ring"' in ring
     assert 'name: CAUSAL\n              value: "0"' in ring
+    lm = (tmp / "manifest_tpu-bench-fsdp-ws4-llama-tp2.yaml").read_text()
+    assert 'name: MODEL_FAMILY\n              value: "llama"' in lm
+    assert 'name: TENSOR_PARALLEL\n              value: "2"' in lm
     zz = (tmp / "manifest_tpu-bench-zero2-ws4-sp2-ring-causal.yaml").read_text()
     assert 'name: CAUSAL\n              value: "1"' in zz
     assert 'name: RING_ZIGZAG\n              value: "auto"' in zz
@@ -286,9 +292,9 @@ def test_roster_rows_survive_dedup(roster_run):
     import pandas as pd
 
     df = pd.read_csv(results / "summary" / "metrics.csv")
-    # 10 composition runs, all (strategy, ws)-colliding pairs kept distinct
+    # 11 composition runs, all (strategy, ws)-colliding pairs kept distinct
     # by the composition axes in the identity key (sp2-ring vs
     # sp2-ring-causal collide on everything except the causal column; the
     # zigzag A/B pair only on ring_zigzag; the two MoE arms only on
-    # n_experts).
-    assert len(df) == 10, df
+    # n_experts; the llama arm on model_family + tensor_parallel).
+    assert len(df) == 11, df

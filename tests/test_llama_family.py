@@ -112,6 +112,34 @@ def test_embed_head_param_names():
     assert head_param_names(dflt) == ("lnf_scale", "lnf_bias", "wte")
 
 
+def test_llama_tier_table():
+    """Tier design: head_dim 128 (the MXU-width shape, PERFORMANCE.md §15),
+    GQA 2:1, causal, no dropout; budgets comparable to the TinyGPT tiers
+    (A ~254M vs 236M, B ~1.64B vs 1.68B)."""
+    from distributed_llm_training_benchmark_framework_tpu.models.llama import (
+        get_llama_config,
+    )
+
+    a = get_llama_config("A", 2048)
+    assert (a.head_dim, a.kv_heads, a.causal, a.dropout) == (128, 4, True, 0.0)
+    assert (a.norm, a.pos_embed, a.mlp_act) == ("rmsnorm", "rope", "swiglu")
+    assert not a.bias and not a.tie_embeddings
+    shapes = jax.eval_shape(lambda k: init_params(a, k), jax.random.key(0))
+    n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
+    assert 245e6 < n < 265e6, n
+
+    b = get_llama_config("B", 1024)
+    assert b.head_dim == 128
+    shapes = jax.eval_shape(lambda k: init_params(b, k), jax.random.key(0))
+    n = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
+    assert 1.55e9 < n < 1.72e9, n
+
+    with pytest.raises(ValueError):
+        get_llama_config("Z", 128)
+    # Overrides pass through like get_model_config's.
+    assert get_llama_config("S", 64, dropout=0.1).dropout == 0.1
+
+
 def test_gqa_matches_repeated_kv_mha():
     """A GQA model equals an MHA model whose fused wqkv repeats each kv head
     over its query group — pins the grouping convention (head h uses kv head
