@@ -82,10 +82,13 @@ MFU_FLOOR_MOE8 = 26.0
 # count — attention work halves under the mask, so the denominator is not
 # the bidirectional rows').
 MFU_FLOOR_CAUSAL_2K = 31.0
-# The published Llama-family 2K row (models.llama tier A: head_dim 128,
-# GQA, SwiGLU, no dropout; measured 45.2% — the wide-head shape clears the
-# D=64 score-tile wall documented in PERFORMANCE.md §15/§16).
-MFU_FLOOR_LLAMA_2K = 42.0
+# The published Llama-family rows (models.llama tier A: head_dim 128,
+# GQA, SwiGLU, no dropout; measured 2K 45.2%, 8K 54.4%, 16K 42.0% — the
+# wide-head shape clears the D=64 score-tile wall documented in
+# PERFORMANCE.md §15/§16, and at long sequences holds ~2x the TinyGPT
+# rows' MFU because the attention fraction grows on the family's more
+# MXU-efficient kernel shape).
+MFU_FLOORS_LLAMA = {2048: 42.0, 8192: 50.0, 16384: 38.0}
 # Routing-health envelope for MoE rows: the capacity discipline drops SOME
 # assignments (cf 1.25 < top-k worst case), but beyond this bound routing
 # has collapsed onto a few experts (or capacity accounting broke).
@@ -161,15 +164,16 @@ def validate_result(r: dict, name: str) -> List[str]:
     base_geometry = (
         family_geometry and r.get("model_family", "tinygpt") == "tinygpt"
     )
+    llama_floor = MFU_FLOORS_LLAMA.get(r.get("seq_len"))
     if (
         family_geometry
         and r.get("model_family") == "llama"
-        and r.get("seq_len") == 2048
+        and llama_floor is not None
         and r.get("n_experts", 0) == 0
     ):
         _check(
-            r["mfu_pct"] >= MFU_FLOOR_LLAMA_2K, name,
-            f"mfu_pct={r['mfu_pct']:.1f}% below the {MFU_FLOOR_LLAMA_2K}% "
+            r["mfu_pct"] >= llama_floor, name,
+            f"mfu_pct={r['mfu_pct']:.1f}% below the {llama_floor}% "
             "llama-family floor (published-row regression)", f,
         )
     published_geometry = base_geometry and not r.get("causal")
