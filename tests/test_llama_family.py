@@ -315,6 +315,74 @@ def test_logits_parity_vs_hf_transformers():
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4, rtol=2e-3)
 
 
+def _run_llama_trajectory(mesh_shape, axis_names, strategy="zero2", steps=3,
+                          dp=1, grad_accum=1, pipeline_schedule="gpipe",
+                          **cfg_kw):
+    import numpy as _np
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        make_mesh, get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train import (
+        create_train_state,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.data import (
+        SyntheticDataset,
+    )
+
+    cfg = llama_cfg(
+        vocab_size=512, n_embd=128, n_head=4, n_kv_head=2, n_layer=2,
+        block_size=64, mlp_hidden=176, compute_dtype=jnp.float32, **cfg_kw
+    )
+    mesh = make_mesh(
+        mesh_shape, axis_names,
+        devices=jax.devices()[: int(_np.prod(mesh_shape))],
+    )
+    state = create_train_state(
+        cfg, get_strategy(strategy), mesh, seed=42, grad_accum=grad_accum,
+        pipeline_schedule=pipeline_schedule,
+    )
+    ds = SyntheticDataset(vocab_size=512, seq_len=64, size=32)
+    params, opt = state.params, state.opt_state
+    losses = []
+    for step in range(steps):
+        batch = ds.batch_for_step(step, dp * 2 * grad_accum)
+        batch = batch.reshape(grad_accum, dp * 2, 64)
+        batch = jax.device_put(batch, state.batch_sharding)
+        params, opt, loss = state.step_fn(params, opt, batch, step)
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.slow
+def test_llama_pipeline_trajectory(eight_devices):
+    """Llama under pipeline parallelism: the generalized embed/head leaf
+    plumbing (untied lm_head, no wpe, rmsnorm scale-only final norm) must
+    reproduce the single-replica trajectory through the 1F1B schedule's
+    stage-sliced vjp accumulation."""
+    axes = ("data", "seq", "model", "pipe")
+    base = _run_llama_trajectory((1, 1, 1, 1), axes, grad_accum=2)
+    pp = _run_llama_trajectory(
+        (1, 1, 1, 2), axes, grad_accum=2, pipeline_schedule="1f1b"
+    )
+    np.testing.assert_allclose(pp, base, rtol=5e-3)
+
+
+@pytest.mark.slow
+def test_llama_pp_sp_rope_manual_offset(eight_devices):
+    """Llama under pp x sp (ring): the ONLY path where RoPE runs inside a
+    sequence-manual shard_map (config.seq_manual_axis set by the pipeline
+    schedule) — each shard must rotate with its global offset
+    (pos + S_local*axis_index), or the trajectory diverges from the
+    single-replica run at step 0."""
+    axes = ("data", "seq", "model", "pipe")
+    base = _run_llama_trajectory((1, 1, 1, 1), axes, grad_accum=2)
+    ppsp = _run_llama_trajectory(
+        (1, 2, 1, 2), axes, grad_accum=2, attention_impl="ring"
+    )
+    np.testing.assert_allclose(ppsp, base, rtol=5e-3)
+
+
 def test_flops_accounting_generalizes():
     """GQA shrinks only the K/V projection term; SwiGLU runs 3 matrices."""
     from distributed_llm_training_benchmark_framework_tpu.utils.flops import (
