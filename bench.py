@@ -9,6 +9,12 @@ ZeRO-2 on 4x A10 at 18,147 tokens/sec total = 4,536.75 tokens/sec/GPU
 (reference README.md:221, BASELINE.md), at the same parity config:
 tier A (~236M params), seq_len 2048, per-device batch 1, grad-accum 4,
 100 steps with 5 warmup steps excluded.
+
+The headline deliberately keeps the reference's model shape + dropout so
+vs_baseline stays apples-to-apples. The framework's fastest measured arm
+is the Llama family (`train_harness.py --model-family llama`): 58.2k
+tok/s at 45.2% MFU on the same chip — see README "Measured results" and
+docs/PERFORMANCE.md §16.
 """
 
 import argparse
