@@ -1,8 +1,9 @@
 #!/usr/bin/env python
-"""Headline benchmark: TinyGPT tier-A tokens/sec/chip on real hardware.
+"""Headline benchmark: parity tokens/sec/chip PLUS the flagship llama arm.
 
 Prints exactly ONE JSON line on stdout:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     ..., "flagship": {...}}
 
 Baseline: the reference's best published per-GPU throughput — DeepSpeed
 ZeRO-2 on 4x A10 at 18,147 tokens/sec total = 4,536.75 tokens/sec/GPU
@@ -10,11 +11,17 @@ ZeRO-2 on 4x A10 at 18,147 tokens/sec total = 4,536.75 tokens/sec/GPU
 tier A (~236M params), seq_len 2048, per-device batch 1, grad-accum 4,
 100 steps with 5 warmup steps excluded.
 
-The headline deliberately keeps the reference's model shape + dropout so
-vs_baseline stays apples-to-apples. The framework's fastest measured arm
-is the Llama family (`train_harness.py --model-family llama`): 58.2k
-tok/s at 45.2% MFU on the same chip — see README "Measured results" and
-docs/PERFORMANCE.md §16.
+The top-level contract keys (metric/value/unit/vs_baseline) deliberately
+keep the reference's model shape + dropout so vs_baseline stays
+apples-to-apples. The framework's FASTEST measured arm is the Llama
+family (58.2k tok/s at 45.2% MFU on the same chip — README "Measured
+results", docs/PERFORMANCE.md §16), and the default invocation now also
+RUNS it: the additive ``"flagship"`` sub-object carries the llama arm's
+tokens/sec/chip, MFU and peak-HBM (with provenance) from a real measured
+run at the family's swept geometry (per-device batch 2 x grad-accum 2,
+unrolled layer loop — §16's published row). ``--model-family llama``
+instead makes the llama arm the top-level metric; ``--flagship off``
+skips the extra run.
 """
 
 import argparse
@@ -26,6 +33,74 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REFERENCE_BEST_TOKENS_PER_SEC_PER_GPU = 18147.0 / 4  # ZeRO-2, 4x A10
+
+# The flagship arm's swept batch geometry (docs/PERFORMANCE.md §16: b2 fills
+# the MXU's M dimension without b4's activation pressure; unrolled beats the
+# scan by ~22% at the family's wider MLP).
+FLAGSHIP_FAMILY = "llama"
+FLAGSHIP_PER_DEVICE_BATCH = 2
+FLAGSHIP_GRAD_ACCUM = 2
+FLAGSHIP_LAYER_LOOP = "unrolled"
+
+
+def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
+                 layer_loop, attention_impl=None, dropout="inherit"):
+    """Run one benchmark arm and return its contract-shaped row dict.
+
+    Shared by the parity row and the flagship sub-object so the contract
+    keys (metric/value/unit/vs_baseline) and the additive visibility keys
+    are built in exactly one place. ``attention_impl``/``dropout`` default
+    to the CLI flags; the flagship caller pins them so its row always
+    means the published configuration.
+    """
+    from distributed_llm_training_benchmark_framework_tpu.parallel import get_strategy
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import run_benchmark
+
+    # Keep stdout clean for the single JSON line; progress goes to stderr.
+    with contextlib.redirect_stdout(sys.stderr):
+        result = run_benchmark(
+            strategy=get_strategy(args.strategy),
+            tier=args.tier,
+            seq_len=args.seq_len,
+            model_family=model_family,
+            steps=args.steps,
+            warmup_steps=args.warmup_steps,
+            per_device_batch=per_device_batch,
+            grad_accum=grad_accum,
+            world_size=world,
+            results_dir=None,
+            attention_impl=(
+                args.attention if attention_impl is None else attention_impl
+            ),
+            dropout=args.dropout if dropout == "inherit" else dropout,
+            sync_every=args.sync_every,
+            layer_loop=layer_loop,
+        )
+    per_chip = result.tokens_per_sec / world
+    return {
+        "metric": (
+            f"{model_family}_tier{args.tier}_seq{args.seq_len}"
+            "_tokens_per_sec_per_chip"
+        ),
+        "value": round(per_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(per_chip / REFERENCE_BEST_TOKENS_PER_SEC_PER_GPU, 3),
+        # Visibility extras (additive; the contract keys above are unchanged):
+        # exactly which semantics produced the number, and how far from peak.
+        "attention_impl": result.attention_impl,
+        "dropout": result.dropout,
+        "model_tflops_per_sec_per_chip": round(
+            result.model_tflops_per_sec_per_chip, 2
+        ),
+        "mfu_pct": round(result.mfu_pct, 2),
+        # Measured peak device memory (allocator or XLA buffer-assignment;
+        # see utils/metrics.measure_peak_hbm) with its provenance.
+        "peak_hbm_gb": round(result.peak_hbm_gb, 2),
+        "peak_hbm_method": result.peak_hbm_method,
+        "tokens_per_dollar": (
+            round(result.tokens_per_dollar) if result.tokens_per_dollar else None
+        ),
+    }
 
 
 def main():
@@ -39,6 +114,16 @@ def main():
     p.add_argument("--grad-accum", type=int, default=4)
     p.add_argument("--world-size", type=int, default=None,
                    help="default: all visible devices")
+    # The top-level metric's model family. 'tinygpt' (default) keeps the
+    # reference-parity architecture for vs_baseline; 'llama' makes the
+    # wide-head family (models/llama.py) the headline row itself.
+    p.add_argument("--model-family", default="tinygpt",
+                   choices=["tinygpt", "llama"])
+    # The flagship sub-object: 'auto' runs the llama arm at its swept
+    # geometry whenever the top-level family is tinygpt (one default
+    # invocation reports both parity AND the framework's honest best);
+    # 'on' forces it even for --model-family llama; 'off' skips the run.
+    p.add_argument("--flagship", default="auto", choices=["auto", "on", "off"])
     # flash is the headline config: same model/loss/optimizer/data as the
     # parity setup, including in-kernel attention-probability dropout (the
     # probabilities still never materialize in HBM). Pass
@@ -64,51 +149,48 @@ def main():
 
     import jax
 
-    from distributed_llm_training_benchmark_framework_tpu.parallel import get_strategy
-    from distributed_llm_training_benchmark_framework_tpu.train.loop import run_benchmark
-
     world = args.world_size or jax.device_count()
 
-    # Keep stdout clean for the single JSON line; progress goes to stderr.
-    with contextlib.redirect_stdout(sys.stderr):
-        result = run_benchmark(
-            strategy=get_strategy(args.strategy),
-            tier=args.tier,
-            seq_len=args.seq_len,
-            steps=args.steps,
-            warmup_steps=args.warmup_steps,
-            per_device_batch=args.per_device_batch,
-            grad_accum=args.grad_accum,
-            world_size=world,
-            results_dir=None,
-            attention_impl=args.attention,
-            dropout=args.dropout,
-            sync_every=args.sync_every,
-            layer_loop=args.layer_loop,
-        )
+    payload = _measure_row(
+        args, world,
+        model_family=args.model_family,
+        per_device_batch=args.per_device_batch,
+        grad_accum=args.grad_accum,
+        layer_loop=args.layer_loop,
+    )
 
-    per_chip = result.tokens_per_sec / world
-    print(json.dumps({
-        "metric": "tinygpt_tierA_seq2048_tokens_per_sec_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(per_chip / REFERENCE_BEST_TOKENS_PER_SEC_PER_GPU, 3),
-        # Visibility extras (additive; the contract keys above are unchanged):
-        # exactly which semantics produced the number, and how far from peak.
-        "attention_impl": result.attention_impl,
-        "dropout": result.dropout,
-        "model_tflops_per_sec_per_chip": round(
-            result.model_tflops_per_sec_per_chip, 2
-        ),
-        "mfu_pct": round(result.mfu_pct, 2),
-        # Measured peak device memory (allocator or XLA buffer-assignment;
-        # see utils/metrics.measure_peak_hbm) with its provenance.
-        "peak_hbm_gb": round(result.peak_hbm_gb, 2),
-        "peak_hbm_method": result.peak_hbm_method,
-        "tokens_per_dollar": (
-            round(result.tokens_per_dollar) if result.tokens_per_dollar else None
-        ),
-    }))
+    run_flagship = args.flagship == "on" or (
+        args.flagship == "auto" and args.model_family != FLAGSHIP_FAMILY
+    )
+    if run_flagship:
+        # The flagship arm: same tier/seq/steps/strategy as the top-level
+        # row, llama family at its swept batch geometry, with the published
+        # row's flash + dropout-free semantics PINNED — a parity-arm
+        # --dropout/--attention override must not silently change what the
+        # "flagship" key measures. Run in the same process, reported
+        # additively.
+        payload["flagship"] = {
+            **_measure_row(
+                args, world,
+                model_family=FLAGSHIP_FAMILY,
+                per_device_batch=FLAGSHIP_PER_DEVICE_BATCH,
+                grad_accum=FLAGSHIP_GRAD_ACCUM,
+                layer_loop=FLAGSHIP_LAYER_LOOP,
+                attention_impl="flash",
+                dropout=None,  # the family's native 0.0
+            ),
+            # Run-identity provenance: exactly which configuration produced
+            # the flagship number (the §16 swept geometry).
+            "model_family": FLAGSHIP_FAMILY,
+            "strategy": args.strategy,
+            "tier": args.tier,
+            "seq_len": args.seq_len,
+            "per_device_batch": FLAGSHIP_PER_DEVICE_BATCH,
+            "grad_accum": FLAGSHIP_GRAD_ACCUM,
+            "layer_loop": FLAGSHIP_LAYER_LOOP,
+        }
+
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":

@@ -97,6 +97,17 @@ EXPERT_OVERFLOW_MAX_PCT = 60.0
 # documents p50 varying 3.6-6.2 s run-to-run; within-run CV stays well
 # under this).
 OFFLOAD_STEP_CV_LIMIT_PCT = 25.0
+# Loss-descent envelope: rows long enough to have visibly trained
+# (>= this many steps) must show loss_last_window <= loss_first_window -
+# delta(family, steps). The mean-loss band alone cannot catch a FROZEN run
+# (a flat line at 6.0 has a healthy-looking mean); this one does. Deltas
+# are conservative fractions of the measured 100-step descents (tinygpt
+# tier A descends ~5 nats in 100 steps; the llama family's measured slow
+# trajectory still descends ~0.49 — see docs/PERFORMANCE.md §16), scaled
+# linearly below 100 steps. Rows without the window keys (pre-round-6
+# artifacts) skip the check.
+LOSS_DESCENT_MIN_STEPS = 50
+LOSS_DESCENT_DELTA = {"tinygpt": 0.25, "llama": 0.15}
 
 
 def _check(ok: bool, label: str, detail: str, failures: List[str]) -> None:
@@ -131,6 +142,28 @@ def validate_result(r: dict, name: str) -> List[str]:
         f"{LOSS_CEIL_SLACK}={ceil:.2f}) — not training or diverged", f,
     )
     _check(loss == loss, name, "mean_loss is NaN", f)
+
+    # Descent envelope (see LOSS_DESCENT_DELTA): a non-training run must not
+    # pass validation on a plausible mean alone. Resumed rows are exempt —
+    # a run restored from a well-trained checkpoint legitimately starts
+    # near its converged loss, with no from-scratch descent left to show.
+    first_w = r.get("loss_first_window", 0.0) or 0.0
+    last_w = r.get("loss_last_window", 0.0) or 0.0
+    if (
+        r.get("steps", 0) >= LOSS_DESCENT_MIN_STEPS
+        and first_w > 0
+        and last_w > 0
+        and not r.get("resumed")
+    ):
+        fam = r.get("model_family", "tinygpt")
+        base = LOSS_DESCENT_DELTA.get(fam, min(LOSS_DESCENT_DELTA.values()))
+        delta = base * min(r["steps"], 100) / 100.0
+        _check(
+            last_w <= first_w - delta, name,
+            f"loss_last_window={last_w:.4f} not below loss_first_window="
+            f"{first_w:.4f} - {delta:.3f} ({fam} descent envelope at "
+            f"{r['steps']} steps) — the run did not train", f,
+        )
 
     if r.get("sync_every", 1) == 1 and r.get("step_time_cv_pct", 0) > 0:
         cv = r["step_time_cv_pct"]

@@ -574,7 +574,10 @@ def _block(
     if c.kv_heads != c.n_head:
         # Broadcast each K/V head to its query group. Consecutive-block
         # repetition matches the TP layout: query-head shard j needs exactly
-        # kv-head shard j when the 'model' degree divides kv_heads.
+        # kv-head shard j when the 'model' degree divides kv_heads; when it
+        # does not, the kv-head-aligned spec rule keeps wkv replicated over
+        # 'model' (strategies.param_partition_specs) so this reshape never
+        # needs the partitioner's full-replicate resharding fallback.
         rep = c.n_head // c.kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)

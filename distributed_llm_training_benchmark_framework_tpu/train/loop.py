@@ -49,7 +49,15 @@ def run_benchmark(*, prng_impl: str = "rbg", **kwargs) -> metrics_mod.BenchmarkR
     if not prng_impl:
         return _run_benchmark_impl(**kwargs)
     prev_impl = jax.config.jax_default_prng_impl
-    jax.config.update("jax_default_prng_impl", prng_impl)
+    try:
+        jax.config.update("jax_default_prng_impl", prng_impl)
+    except ValueError:
+        # Older jax spells the threefry enum value 'threefry2x32'; the CLI
+        # name stays 'threefry' (bit-identical generator either way).
+        alias = {"threefry": "threefry2x32"}.get(prng_impl)
+        if alias is None:
+            raise
+        jax.config.update("jax_default_prng_impl", alias)
     try:
         return _run_benchmark_impl(**kwargs)
     finally:
@@ -312,6 +320,12 @@ def _run_benchmark_impl(
             )
 
     t_init = time.perf_counter()
+    # Snapshot the allocator's process-lifetime high-water mark BEFORE this
+    # arm allocates anything: when several arms share one process (bench.py
+    # parity + flagship) the mark has no reset, and a later arm must not
+    # publish an earlier arm's peak as its own (metrics.measure_peak_hbm
+    # falls to the per-executable rung when the run didn't raise the mark).
+    prior_peak_bytes = metrics_mod.peak_hbm_bytes()
     dpu_serial_phase = strategy.offload_delayed_update and offload_dpu_start_step > 0
     # With a serial pre-phase, the DPU state is created ABSTRACT (zero
     # allocation): only its step_fn and the pending slot's layout are
@@ -495,7 +509,10 @@ def _run_benchmark_impl(
     # guard avoids even that (and any cache-miss recompile) on runtimes
     # whose memory_stats() works.
     compiled_step = None
-    if metrics_mod.peak_hbm_bytes() is None:
+    _alloc_peak = metrics_mod.peak_hbm_bytes()
+    if _alloc_peak is None or (
+        prior_peak_bytes is not None and _alloc_peak <= prior_peak_bytes
+    ):
         try:
             compiled_step = active_state.aot_compile(params, opt_state, table, 0)
         except Exception as e:  # degrade down the fallback chain, never fail a run
@@ -577,6 +594,8 @@ def _run_benchmark_impl(
         ),
         expert_overflow_pct=expert_overflow_pct,
         model_family=model_family,
+        resumed=start_step > 0,
+        prior_peak_bytes=prior_peak_bytes,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

@@ -132,6 +132,7 @@ def make_train_step(
         jax.eval_shape(functools.partial(tinygpt.init_params, cfg), jax.random.key(0)),
         mesh,
         shard=True,
+        kv_heads=cfg.kv_heads,
     )
     batch_spec = strat.batch_partition_spec(mesh)
     # (accum, batch, seq): shard the *batch* dim, accum dim is sequential.
@@ -326,10 +327,11 @@ def abstract_step_peak_bytes(
         lambda key: tinygpt.init_params(cfg, key), jax.random.key(0)
     )
     param_specs = strat.param_partition_specs(
-        params_shape, mesh, shard=strategy.shard_params
+        params_shape, mesh, shard=strategy.shard_params, kv_heads=cfg.kv_heads
     )
     opt_specs = strat.opt_state_partition_specs(
-        optimizer, params_shape, param_specs, mesh, shard=strategy.shard_opt_state
+        optimizer, params_shape, param_specs, mesh,
+        shard=strategy.shard_opt_state, kv_heads=cfg.kv_heads,
     )
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
 
@@ -373,8 +375,10 @@ def abstract_step_peak_bytes(
             sharding=NamedSharding(mesh, P(None, *strat.batch_partition_spec(mesh))),
         )
     try:
+        from ..utils import metrics as metrics_mod
+
         compiled = aot_compile(params_abs, opt_abs, batch_abs, 0)
-        peak = int(getattr(compiled.memory_analysis(), "peak_memory_in_bytes", 0))
+        peak = metrics_mod.buffer_assignment_peak_bytes(compiled.memory_analysis())
         return peak if peak > 0 else None
     except Exception as e:
         # A compiler HBM-OOM here legitimately means "this policy does not
@@ -441,10 +445,11 @@ def create_train_state(
 
     params_shape = jax.eval_shape(init_fn, jax.random.key(0))
     param_specs = strat.param_partition_specs(
-        params_shape, mesh, shard=strategy.shard_params
+        params_shape, mesh, shard=strategy.shard_params, kv_heads=cfg.kv_heads
     )
     opt_specs = strat.opt_state_partition_specs(
-        optimizer, params_shape, param_specs, mesh, shard=strategy.shard_opt_state
+        optimizer, params_shape, param_specs, mesh,
+        shard=strategy.shard_opt_state, kv_heads=cfg.kv_heads,
     )
 
     if abstract_init:

@@ -1,0 +1,125 @@
+"""Polyfills for newer-JAX public APIs on older jax runtimes.
+
+The codebase targets the current JAX API surface (``jax.set_mesh``,
+``jax.shard_map``, ``jax.typeof``, ``jax.sharding.get_abstract_mesh``).
+Some deployment images pin an older jax (observed: 0.4.37) where those
+names do not exist yet but the underlying machinery does:
+
+- ``jax.set_mesh(mesh)``              -> entering the ``Mesh`` context
+  manager sets the thread-local resource env, which is what the fallback
+  ``get_abstract_mesh`` below reads back.
+- ``jax.shard_map(..., axis_names=)`` -> ``jax.experimental.shard_map
+  .shard_map(..., auto=mesh.axis_names - axis_names)``. The old API tracks
+  replication via ``check_rep`` instead of the vma type system; the
+  wrapper passes ``check_rep=False`` because programs written for the vma
+  world carry no replication annotations the old checker could verify
+  (``utils.vma`` degrades to no-ops on the same condition).
+- ``jax.typeof(x)``                   -> ``jax.core.get_aval(x)`` (the old
+  avals simply lack the ``vma`` attribute, which ``utils.vma`` treats as
+  "varies over nothing").
+- ``jax.sharding.get_abstract_mesh()``-> the resource env's physical mesh
+  (``None``-like empty mesh when no ``set_mesh`` context is active; all
+  callers only probe ``.axis_names`` / ``.shape``, which a concrete
+  ``Mesh`` satisfies).
+
+``install()`` is idempotent and a strict no-op on jax versions that
+already export the real APIs — the polyfill never shadows an upstream
+implementation.
+
+Known residual limitation on old jax: the legacy PARTIALLY-auto shard_map
+(the pipeline schedules' manual-over-'pipe' region) compiles and passes
+its unit tests, but the end-to-end harness pipeline arms can hit legacy
+autodiff/partitioner gaps XLA later fixed (malformed rank-1 residual
+shardings; "PartitionId instruction is not supported" on XLA:CPU SPMD).
+Pipeline e2e runs need the current jax the codebase targets; everything
+else (all four strategy arms, tp, sp rings/Ulysses, MoE ep, the llama
+family, bench.py both arms) runs fully under the polyfill.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+
+def install() -> None:
+    """Install missing new-API names onto ``jax``. Safe to call repeatedly."""
+    import jax
+
+    if not hasattr(jax, "typeof"):
+        import jax.core
+
+        jax.typeof = jax.core.get_aval
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+        @functools.wraps(_legacy_shard_map)
+        def _shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                       axis_names=None, check_vma=None, **kwargs):
+            if mesh is None:
+                mesh = _current_mesh()
+                if mesh is None:
+                    raise ValueError(
+                        "jax.shard_map polyfill: no mesh argument and no "
+                        "surrounding set_mesh context"
+                    )
+            if axis_names is not None:
+                auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            else:
+                auto = frozenset()
+            mapped = _legacy_shard_map(
+                f, mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False, auto=auto, **kwargs,
+            )
+            # The legacy partially-auto path exists only under jit
+            # (_shard_map_impl raises NotImplementedError eagerly); under an
+            # outer jit trace the inner jit is inlined, so this wrap is
+            # semantics-free.
+            return jax.jit(mapped) if auto else mapped
+
+        jax.shard_map = _shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        from jax import lax as _lax
+
+        def _axis_size(axis_name):
+            # psum of a Python literal constant-folds to the axis size
+            # (no runtime collective) on every jax version.
+            return _lax.psum(1, axis_name)
+
+        jax.lax.axis_size = _axis_size
+
+    if not hasattr(jax, "set_mesh"):
+
+        @contextlib.contextmanager
+        def _set_mesh(mesh):
+            # Entering the Mesh context sets the thread-local resource env
+            # that the get_abstract_mesh fallback reads back.
+            with mesh:
+                yield mesh
+
+        jax.set_mesh = _set_mesh
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = _current_mesh
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"
+        ):
+            # Renamed upstream (TPUCompilerParams -> CompilerParams); same
+            # dataclass either way.
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except Exception:
+        pass
+
+
+def _current_mesh():
+    """The mesh of the innermost active ``set_mesh`` context, or None."""
+    from jax._src.mesh import thread_resources
+
+    mesh = thread_resources.env.physical_mesh
+    return mesh if mesh.axis_names else None

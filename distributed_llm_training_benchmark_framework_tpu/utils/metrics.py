@@ -57,8 +57,33 @@ def peak_hbm_bytes() -> Optional[int]:
     return max(peaks) if peaks else None
 
 
+def buffer_assignment_peak_bytes(ma) -> int:
+    """XLA's buffer-assignment peak from a ``memory_analysis()`` result.
+
+    Current jaxlib exposes ``peak_memory_in_bytes`` directly; older
+    ``CompiledMemoryStats`` (pre-0.4.38) only carries the component sizes,
+    whose sum (arguments + outputs + temporaries, donation-aliased bytes
+    counted once) is the same buffer-assignment quantity. Returns 0 when
+    neither form is available.
+    """
+    peak = int(getattr(ma, "peak_memory_in_bytes", 0) or 0)
+    if peak > 0:
+        return peak
+    try:
+        parts = (
+            int(getattr(ma, "argument_size_in_bytes", 0) or 0)
+            + int(getattr(ma, "output_size_in_bytes", 0) or 0)
+            + int(getattr(ma, "temp_size_in_bytes", 0) or 0)
+            - int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+        )
+        return max(parts, 0)
+    except Exception:
+        return 0
+
+
 def measure_peak_hbm(
-    compiled_step=None, host_offload: bool = False
+    compiled_step=None, host_offload: bool = False,
+    prior_peak_bytes: Optional[int] = None,
 ) -> tuple[float, str]:
     """Measured per-device peak memory in GB, with provenance.
 
@@ -68,7 +93,13 @@ def measure_peak_hbm(
        the runtime allocator's true high-water mark (reference parity:
        ``torch.cuda.max_memory_allocated``, ``train_harness.py:406-408``).
        Works on standard Cloud TPU runtimes; returns None on some PJRT
-       plugins (and on CPU).
+       plugins (and on CPU). The high-water mark is PROCESS-lifetime and
+       has no reset API, so when several arms run in one process (bench.py
+       measures parity then flagship) a later arm would silently inherit
+       an earlier, larger arm's peak: callers pass ``prior_peak_bytes``
+       (the mark observed before their run) and this rung only claims the
+       number when the run actually raised it; otherwise the chain falls
+       through to the per-executable rung 2.
     2. ``xla_buffer_assignment`` — ``compiled_step.memory_analysis()``
        ``.peak_memory_in_bytes``: the XLA compiler's buffer-assignment peak
        for the train-step executable (arguments + outputs + temporaries,
@@ -88,12 +119,12 @@ def measure_peak_hbm(
     Returns (peak_gb, method).
     """
     peak = peak_hbm_bytes()
-    if peak:
+    if peak and (prior_peak_bytes is None or peak > prior_peak_bytes):
         return peak / 1e9, "allocator"
     if compiled_step is not None:
         try:
             ma = compiled_step.memory_analysis()
-            peak_bytes = int(getattr(ma, "peak_memory_in_bytes", 0))
+            peak_bytes = buffer_assignment_peak_bytes(ma)
             # Host-offload arms only (``host_offload``): the
             # buffer-assignment peak sums ALL memory spaces, so pinned-host
             # buffers (fp32 masters + Adam moments) would masquerade as
@@ -234,6 +265,18 @@ class BenchmarkResult:
     # the RMSNorm/RoPE/SwiGLU/GQA family, models.llama) — run identity: a
     # llama tier-A row is a different model than a tinygpt tier-A row.
     model_family: str = "tinygpt"
+    # Loss-descent endpoints: means of the first/last ``loss_window_steps``
+    # timed (post-warmup) per-step losses. mean_loss alone cannot distinguish
+    # a training run from a frozen one (a flat line and a descent can share a
+    # mean); the validator's descent envelope
+    # (analysis.validate_results) compares these. 0.0 when no losses.
+    loss_first_window: float = 0.0
+    loss_last_window: float = 0.0
+    loss_window_steps: int = 0
+    # True when the run restored a checkpoint and continued (--resume): its
+    # loss starts wherever the checkpoint left off, so the from-scratch
+    # descent envelope does not apply.
+    resumed: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -285,9 +328,19 @@ def compute_result(
     ring_zigzag: str = "auto",
     expert_overflow_pct: Optional[float] = None,
     model_family: str = "tinygpt",
+    resumed: bool = False,
+    prior_peak_bytes: Optional[int] = None,
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
+    # Descent endpoints: window of up to 10 steps, at most a fifth of the
+    # timed run each so the two windows never overlap at benchmark lengths.
+    if losses:
+        lw = max(1, min(10, len(losses) // 5))
+        loss_first = sum(losses[:lw]) / lw
+        loss_last = sum(losses[-lw:]) / lw
+    else:
+        lw, loss_first, loss_last = 0, 0.0, 0.0
     # Honest accounting: a step consumes per_device_batch * grad_accum
     # sequences per *data-parallel replica* (our accumulation is real, and
     # tensor/sequence-parallel groups jointly compute one example rather than
@@ -304,7 +357,8 @@ def compute_result(
     bytes_per_step = per_device_batch * grad_accum * seq_len * 4
     h2d = (bytes_per_step / mean_step) / 1e9 if mean_step > 0 else 0.0
     peak_gb, peak_method = measure_peak_hbm(
-        compiled_step, host_offload=offload_opt_state
+        compiled_step, host_offload=offload_opt_state,
+        prior_peak_bytes=prior_peak_bytes,
     )
     from . import flops as flops_mod
 
@@ -371,6 +425,10 @@ def compute_result(
         ring_zigzag=ring_zigzag,
         expert_overflow_pct=expert_overflow_pct,
         model_family=model_family,
+        loss_first_window=loss_first,
+        loss_last_window=loss_last,
+        loss_window_steps=lw,
+        resumed=resumed,
     )
 
 

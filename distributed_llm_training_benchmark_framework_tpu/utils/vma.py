@@ -26,7 +26,14 @@ def vma_of(*arrays) -> FrozenSet[str]:
 
 def pcast_missing(x, axes: Iterable[str]):
     """pcast ``x`` to vary over ``axes``, skipping axes it already varies
-    over (pcast rejects varying->varying)."""
+    over (pcast rejects varying->varying).
+
+    On jax runtimes without ``lax.pcast`` (pre-vma shard_map, where the
+    compat layer runs shard_map with replication checking off) there is no
+    varying-axes type system to satisfy, so this is the identity.
+    """
+    if not hasattr(lax, "pcast"):
+        return x
     have = vma_of(x)
     need = tuple(a for a in axes if a not in have)
     return lax.pcast(x, need, to="varying") if need else x

@@ -62,6 +62,26 @@ export OFFLOAD_DPU_START_STEP="${OFFLOAD_DPU_START_STEP:-0}"
 export CAUSAL="${CAUSAL:-0}"
 export MODEL_FAMILY="${MODEL_FAMILY:-tinygpt}"
 export RING_ZIGZAG="${RING_ZIGZAG:-auto}"
+# Full flag-surface coverage (empty = harness default; the drift-detector
+# test in tests/test_distributed_runtime.py pins that every harness flag is
+# reachable from the container env, so new flags cannot silently miss the
+# k8s path).
+export SEED="${SEED:-}"
+export SYNC_EVERY="${SYNC_EVERY:-}"
+export DATASET_SIZE="${DATASET_SIZE:-}"
+export DROPOUT="${DROPOUT:-}"
+export PRNG_IMPL="${PRNG_IMPL:-}"
+export SKIP_MEMORY_CHECK="${SKIP_MEMORY_CHECK:-0}"
+export FLASH_BLOCK_Q="${FLASH_BLOCK_Q:-}"
+export FLASH_BLOCK_K="${FLASH_BLOCK_K:-}"
+export FLASH_BLOCK_K_BWD="${FLASH_BLOCK_K_BWD:-}"
+export FLASH_PALLAS_BACKWARD="${FLASH_PALLAS_BACKWARD:-0}"
+export FLASH_BLOCKWISE_BACKWARD="${FLASH_BLOCKWISE_BACKWARD:-0}"
+export PROFILE_DIR="${PROFILE_DIR:-}"
+export CHECKPOINT_DIR="${CHECKPOINT_DIR:-}"
+export CHECKPOINT_EVERY="${CHECKPOINT_EVERY:-}"
+export RESUME="${RESUME:-0}"
+export DEBUG="${DEBUG:-0}"
 
 echo "Config:"
 for v in STRATEGY WORLD_SIZE NUM_PROCESSES RANK MASTER_ADDR MASTER_PORT \
@@ -114,6 +134,34 @@ if [ "${CAUSAL}" = "1" ]; then
   ARGS="${ARGS} --causal"; fi
 if [ "${RING_ZIGZAG}" != "auto" ]; then
   ARGS="${ARGS} --ring-zigzag ${RING_ZIGZAG}"; fi
+# Valued knobs: empty means "use the harness default".
+if [ -n "${SEED}" ]; then ARGS="${ARGS} --seed ${SEED}"; fi
+if [ -n "${SYNC_EVERY}" ]; then ARGS="${ARGS} --sync-every ${SYNC_EVERY}"; fi
+if [ -n "${DATASET_SIZE}" ]; then
+  ARGS="${ARGS} --dataset-size ${DATASET_SIZE}"; fi
+if [ -n "${DROPOUT}" ]; then ARGS="${ARGS} --dropout ${DROPOUT}"; fi
+if [ -n "${PRNG_IMPL}" ]; then ARGS="${ARGS} --prng-impl ${PRNG_IMPL}"; fi
+if [ -n "${FLASH_BLOCK_Q}" ]; then
+  ARGS="${ARGS} --flash-block-q ${FLASH_BLOCK_Q}"; fi
+if [ -n "${FLASH_BLOCK_K}" ]; then
+  ARGS="${ARGS} --flash-block-k ${FLASH_BLOCK_K}"; fi
+if [ -n "${FLASH_BLOCK_K_BWD}" ]; then
+  ARGS="${ARGS} --flash-block-k-bwd ${FLASH_BLOCK_K_BWD}"; fi
+if [ -n "${PROFILE_DIR}" ]; then
+  ARGS="${ARGS} --profile-dir ${PROFILE_DIR}"; fi
+if [ -n "${CHECKPOINT_DIR}" ]; then
+  ARGS="${ARGS} --checkpoint-dir ${CHECKPOINT_DIR}"; fi
+if [ -n "${CHECKPOINT_EVERY}" ]; then
+  ARGS="${ARGS} --checkpoint-every ${CHECKPOINT_EVERY}"; fi
+# Boolean knobs: 1 = pass the flag.
+if [ "${SKIP_MEMORY_CHECK}" = "1" ]; then
+  ARGS="${ARGS} --skip-memory-check"; fi
+if [ "${FLASH_PALLAS_BACKWARD}" = "1" ]; then
+  ARGS="${ARGS} --flash-pallas-backward"; fi
+if [ "${FLASH_BLOCKWISE_BACKWARD}" = "1" ]; then
+  ARGS="${ARGS} --flash-blockwise-backward"; fi
+if [ "${RESUME}" = "1" ]; then ARGS="${ARGS} --resume"; fi
+if [ "${DEBUG}" = "1" ]; then ARGS="${ARGS} --debug"; fi
 if [[ "${SYNTHETIC}" == "true" ]]; then ARGS="${ARGS} --synthetic"; fi
 if [[ "${STRATEGY}" == "zero2" || "${STRATEGY}" == "zero3" ]]; then
   ARGS="${ARGS} --strategy-config /app/configs/strategies/${STRATEGY}.json"

@@ -58,8 +58,11 @@ fi
 # (>= 4 chips: 2-way composition axis x >= 2-way data), the suite
 # auto-appends one run per extended-axis arm at that world size — tensor,
 # pipeline (all three schedules), sequence (ring + Ulysses) and expert
-# parallelism — so ONE invocation on a pod slice produces the complete
-# scaling story, the way the reference hard-codes its full matrix
+# parallelism, plus the llama-flagship arm (the family at its swept
+# b2 x accum2 unrolled flash geometry — the bench.py flagship sub-object's
+# configuration, reproducible from the suite orchestrator) — so ONE
+# invocation on a pod slice produces the complete scaling story, the way
+# the reference hard-codes its full matrix
 # (reference scripts/run_all_benchmarks.sh fixed strategy x gpu grid).
 # COMPOSITIONS=off disables; =only skips the pure-strategy matrix.
 COMPOSITIONS="${COMPOSITIONS:-auto}"
@@ -205,6 +208,7 @@ sp2-ulysses|zero2|--sequence-parallel 2 --attention ulysses|--sequence-parallel 
 moe-ep2|zero2|--num-experts 4 --expert-parallel 2|--num-experts 4 --expert-parallel 2
 moe8-ep2|zero2|--num-experts 8 --expert-parallel 2|--num-experts 8 --expert-parallel 2
 llama-tp2|fsdp|--model-family llama --tensor-parallel 2|--model-family llama --tensor-parallel 2
+llama-flagship|zero2|--model-family llama --per-device-batch 2 --grad-accum 2 --layer-loop unrolled --attention flash|--model-family llama --per-device-batch 2 --grad-accum 2 --layer-loop unrolled --attention flash
 "
   echo ""
   echo "=== Composition arms (ws=$WS_MAX) ==="

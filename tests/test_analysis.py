@@ -254,6 +254,49 @@ def test_validate_results_llama_mfu_floor(tmp_path):
     assert not any("floor" in f for f in failures)
 
 
+def test_validate_results_loss_descent_envelope(tmp_path):
+    """The deliberately-FROZEN llama fixture must fail: 100 steps whose
+    first and last loss windows are identical (a plausible mean, zero
+    descent) is a run that did not train. A descending row passes, a short
+    smoke row (< 50 steps) and a pre-envelope row (no window keys) are
+    exempt."""
+    frozen = result(
+        strategy="zero2", steps=100, model_family="llama", mean_loss=6.3,
+        loss_first_window=6.31, loss_last_window=6.31, loss_window_steps=10,
+    )
+    write_results(tmp_path, [frozen])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert any("did not train" in f for f in failures), failures
+    # Healthy descent (llama's measured slow trajectory: 10.58 -> 10.09).
+    write_results(tmp_path, [dict(
+        frozen, mean_loss=10.3, loss_first_window=10.55,
+        loss_last_window=10.09,
+    )])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("did not train" in f for f in failures), failures
+    # Short smoke runs are exempt (steps < 50)...
+    write_results(tmp_path, [dict(frozen, steps=8)])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("did not train" in f for f in failures), failures
+    # ...and so are rows without the window keys (pre-round-6 artifacts)...
+    legacy = result(strategy="zero2", steps=100, model_family="llama")
+    write_results(tmp_path, [legacy])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("did not train" in f for f in failures), failures
+    # ...and resumed rows, which legitimately start near converged loss.
+    write_results(tmp_path, [dict(frozen, resumed=True)])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert not any("did not train" in f for f in failures), failures
+    # The tinygpt envelope is stricter: a 100-step tinygpt row descending
+    # only 0.2 nats fails where a llama row would pass.
+    write_results(tmp_path, [dict(
+        frozen, model_family="tinygpt", mean_loss=6.2,
+        loss_first_window=6.31, loss_last_window=6.11,
+    )])
+    failures, _ = vr.collect(str(tmp_path), None)
+    assert any("did not train" in f for f in failures), failures
+
+
 def test_validate_results_published_artifacts_pass():
     """The committed example_output must satisfy its own envelopes —
     including the new MFU floors against the published rows."""

@@ -1,0 +1,98 @@
+"""The bench.py stdout contract: one JSON line, legacy keys + flagship.
+
+``bench.py`` is the repo's headline emitter — the one line outside tooling
+parses. Round 6 added the flagship sub-object (the llama arm measured at
+its swept b2 x accum2 geometry, docs/PERFORMANCE.md §16) to the default
+invocation; these CPU smoke runs (tier S, 3 steps) pin the contract shape:
+
+- exactly ONE line on stdout, valid JSON (progress goes to stderr);
+- the legacy contract keys (metric/value/unit/vs_baseline) unchanged in
+  name and semantics;
+- the additive ``flagship`` sub-object present by default, carrying the
+  llama arm's throughput/MFU/peak-HBM with run-identity provenance;
+- ``--model-family llama`` promotes the family to the top-level metric
+  (and, being the flagship family itself, emits no duplicate sub-object).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+SMOKE_ARGS = [
+    "--tier", "S", "--seq-len", "64", "--steps", "3",
+    "--warmup-steps", "1", "--world-size", "1",
+]
+
+
+def run_bench(*extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, BENCH, *SMOKE_ARGS, *extra],
+        capture_output=True, text=True, env=env, timeout=900, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    return proc
+
+
+@pytest.fixture(scope="module")
+def default_run():
+    return run_bench()
+
+
+def test_stdout_is_exactly_one_json_line(default_run):
+    lines = [l for l in default_run.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, default_run.stdout
+    json.loads(lines[0])  # must parse
+
+
+def test_legacy_contract_keys_unchanged(default_run):
+    r = json.loads(default_run.stdout)
+    # Names AND semantics: the metric string scheme, a positive per-chip
+    # throughput, the unit literal, and vs_baseline = value / the
+    # reference's best per-GPU number.
+    assert r["metric"] == "tinygpt_tierS_seq64_tokens_per_sec_per_chip"
+    assert r["unit"] == "tokens/sec/chip"
+    assert r["value"] > 0
+    assert r["vs_baseline"] == pytest.approx(r["value"] / 4536.75, rel=1e-2)
+
+
+def test_flagship_subobject_present_with_expected_keys(default_run):
+    r = json.loads(default_run.stdout)
+    f = r["flagship"]
+    for key in (
+        "metric", "value", "unit", "vs_baseline", "model_family", "strategy",
+        "tier", "seq_len", "per_device_batch", "grad_accum", "layer_loop",
+        "attention_impl", "dropout", "mfu_pct", "peak_hbm_gb",
+        "peak_hbm_method",
+    ):
+        assert key in f, key
+    assert f["metric"] == "llama_tierS_seq64_tokens_per_sec_per_chip"
+    assert f["value"] > 0
+    # The flagship arm's swept run-identity (docs/PERFORMANCE.md §16):
+    # llama family, per-device batch 2 x grad-accum 2, unrolled layers,
+    # the family's native dropout-free semantics.
+    assert f["model_family"] == "llama"
+    assert f["per_device_batch"] == 2
+    assert f["grad_accum"] == 2
+    assert f["layer_loop"] == "unrolled"
+    assert f["dropout"] == 0.0
+
+
+def test_llama_as_top_level_family():
+    proc = run_bench("--model-family", "llama")
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1
+    r = json.loads(lines[0])
+    assert r["metric"] == "llama_tierS_seq64_tokens_per_sec_per_chip"
+    assert r["value"] > 0
+    # The top-level row IS the flagship family: no duplicate sub-object
+    # under --flagship auto.
+    assert "flagship" not in r

@@ -66,6 +66,15 @@ def two_process_run(tmp_path_factory):
                 q.kill()
             raise
         outs.append((p.returncode, out, err))
+    if any(
+        "computations aren't implemented on the CPU backend" in (out + err)
+        for _, out, err in outs
+    ):
+        # Older XLA:CPU clients cannot run cross-process computations at
+        # all — an environment capability limit (same class as the TPU
+        # topology-compile skip in test_collective_lowering), not a harness
+        # regression.
+        pytest.skip("this jaxlib's CPU backend has no multi-process support")
     return outs, results
 
 
@@ -180,3 +189,61 @@ def test_entrypoint_zero_arm_gets_strategy_config(tmp_path):
     joined = " ".join(argv)
     assert "--strategy zero3" in joined
     assert "--strategy-config /app/configs/strategies/zero3.json" in joined
+
+
+def test_entrypoint_extended_knobs_reach_argv(tmp_path):
+    """The round-6 env plumbing is live end-to-end, valued and boolean."""
+    rc, log, argv = run_entrypoint(tmp_path, {
+        "SYNC_EVERY": "10", "DROPOUT": "0.0", "SEED": "7",
+        "SKIP_MEMORY_CHECK": "1", "RESUME": "1",
+    })
+    assert rc == 0, log
+    joined = " ".join(argv)
+    assert "--sync-every 10" in joined
+    assert "--dropout 0.0" in joined
+    assert "--seed 7" in joined
+    assert "--skip-memory-check" in joined
+    assert "--resume" in joined
+
+
+# Harness flags deliberately NOT reachable from the container env, with the
+# reason each is exempt from the drift detector below:
+#   --local-rank        accepted for reference-CLI parity only; device
+#                       selection is mesh-driven on TPU (harness help text)
+#   --deepspeed-config  alias of --strategy-config, which the entrypoint
+#   --fsdp-config       already sets for the ZeRO arms
+ENTRYPOINT_EXEMPT_FLAGS = {"--local-rank", "--deepspeed-config", "--fsdp-config"}
+
+
+def test_entrypoint_covers_harness_flag_surface():
+    """Drift detector: the env-var contract in docker/entrypoint.sh must
+    cover ``train/harness.py::build_parser()``'s flag surface exactly
+    (modulo the documented exemptions above), in BOTH directions — a flag
+    added to the harness cannot silently miss the container path, and the
+    entrypoint cannot carry a stale/renamed flag the harness would reject.
+    """
+    import re
+
+    from distributed_llm_training_benchmark_framework_tpu.train.harness import (
+        build_parser,
+    )
+
+    parser_flags = set()
+    for action in build_parser()._actions:
+        parser_flags.update(
+            o for o in action.option_strings if o.startswith("--")
+        )
+    parser_flags.discard("--help")
+
+    text = open(ENTRYPOINT).read()
+    entry_flags = set(re.findall(r"--[a-z][a-z0-9-]+", text))
+
+    stale = entry_flags - parser_flags
+    assert not stale, (
+        f"entrypoint.sh passes flags the harness does not define: {sorted(stale)}"
+    )
+    missing = parser_flags - entry_flags - ENTRYPOINT_EXEMPT_FLAGS
+    assert not missing, (
+        "harness flags with no container-env plumbing in entrypoint.sh "
+        f"(add an env var or an explicit exemption): {sorted(missing)}"
+    )

@@ -7,8 +7,10 @@ zero new code: SUITE_DRY_RUN=1 prints the exact run plan, and these tests
 assert it is the reference's full matrix shape
 (`/root/reference/scripts/run_all_benchmarks.sh` hard-codes strategy x
 gpu-count) widened to {strategies} x {1, 2, 4, 8} (a true ws=1 baseline,
-which the reference lacked) PLUS the 10-arm composition roster at the
-widest world size — including the zigzag-on/off causal ring A/B pair
+which the reference lacked) PLUS the composition roster at the
+widest world size (now including the llama-flagship arm — the bench.py
+flagship sub-object's b2 x accum2 unrolled flash geometry, reproducible
+from the suite orchestrator) — including the zigzag-on/off causal ring A/B pair
 whose wall-clock difference is THE scaling-day measurement for the
 round-4 ring work.
 """
@@ -22,7 +24,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 COMPOSITION_ARMS = {
     "tp2", "pp2-gpipe", "pp2-1f1b", "pp2-interleaved",
     "sp2-ring", "sp2-ring-causal", "sp2-ring-causal-nozz", "sp2-ulysses",
-    "moe-ep2", "moe8-ep2", "llama-tp2",
+    "moe-ep2", "moe8-ep2", "llama-tp2", "llama-flagship",
 }
 
 
