@@ -28,11 +28,47 @@ import argparse
 import contextlib
 import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 REFERENCE_BEST_TOKENS_PER_SEC_PER_GPU = 18147.0 / 4  # ZeRO-2, 4x A10
+
+# graftcheck preflight scope: the lint rules plus the HLO audit of the arm
+# whose budget guards the headline number (the llama x tp GQA arm — the PR 1
+# resharding regression class). The full roster audit runs in CI and in
+# scripts/run_all_benchmarks.sh; here one representative compile (~10 s on
+# the host CPU) buys the fail-fast without delaying the measured run.
+PREFLIGHT_ARGS = ("--lint", "--audit", "--arms", "llama-tp2-gqa")
+
+
+def run_preflight() -> None:
+    """Run graftcheck in a subprocess; refuse to launch arms on failure.
+
+    A subprocess because the static audit must compile on the CPU backend
+    with its own forced 8-device geometry, while THIS process is about to
+    own the TPU runtime — the two backends must not share a process. The
+    CLI pins its env itself; output goes to stderr (stdout stays reserved
+    for the single JSON result line).
+    """
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "distributed_llm_training_benchmark_framework_tpu"
+            ".analysis.static", *PREFLIGHT_ARGS,
+        ],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        stdout=sys.stderr, stderr=sys.stderr,
+    )
+    if proc.returncode != 0:
+        print(
+            "bench.py: graftcheck preflight FAILED (see above) — refusing "
+            "to launch benchmark arms. Fix the findings, or rerun with "
+            "--skip-preflight to measure anyway.",
+            file=sys.stderr,
+        )
+        sys.exit(2)
 
 # The flagship arm's swept batch geometry (docs/PERFORMANCE.md §16: b2 fills
 # the MXU's M dimension without b4's activation pressure; unrolled beats the
@@ -139,7 +175,14 @@ def main():
     # (no dynamic-update-slice activation stacking); scan remains the
     # harness default for compile time and pipeline runs.
     p.add_argument("--layer-loop", default="unrolled", choices=["scan", "unrolled"])
+    # Static preflight (analysis.static: collective-budget audit + lint)
+    # runs before any arm launches; see run_preflight for scope.
+    p.add_argument("--skip-preflight", action="store_true",
+                   help="skip the graftcheck static preflight gate")
     args = p.parse_args()
+
+    if not args.skip_preflight:
+        run_preflight()
 
     from distributed_llm_training_benchmark_framework_tpu.utils.platform import (
         honor_jax_platforms_env,

@@ -1,0 +1,420 @@
+"""Engine 1: the HLO collective-budget auditor.
+
+The compiled HLO for every strategy arm is a deterministic, CPU-lowerable
+artifact: ``train.step.abstract_compile_step`` compiles the REAL train-step
+executable from ``ShapeDtypeStruct``s over a virtual CPU mesh (the same
+machinery the auto-remat probe and ``tests/test_collective_lowering.py``
+use), so regressions in collective counts, donation, and dtype promotion
+are catchable in CI before any TPU time is spent. PR 1's motivating case:
+a single unchased GSPMD full-replication fallback on the llama x tp GQA kv
+projections cost 6 collective-permutes + 8 all-gathers per step and was
+only caught by a one-off HLO test — this module makes that class of check
+systematic, per arm, against frozen budgets.
+
+Determinism contract: counts are a property of (jax/XLA version, backend,
+device count, arm config). Budgets are frozen on the CPU backend with 8
+forced host devices (``scripts/graftcheck.sh`` / the CLI force both); a
+jax upgrade legitimately moves counts — regenerate with
+``--update-budgets`` and review the diff like any other lockfile change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "..")
+)
+DEFAULT_BUDGETS_PATH = os.path.join(REPO_ROOT, "configs", "collective_budgets.json")
+
+#: The collective opcodes the auditor counts, in report order.
+COLLECTIVE_OPS = (
+    "all-gather",
+    "reduce-scatter",
+    "all-reduce",
+    "collective-permute",
+    "all-to-all",
+)
+
+_INJECTIONS = ("bad-kv-spec",)
+
+
+@dataclasses.dataclass(frozen=True)
+class ArmSpec:
+    """One auditable arm: strategy x model family x mesh geometry.
+
+    ``config_overrides`` is a tuple of (key, value) pairs passed to the
+    model-config factory (tuple, not dict, so the spec stays hashable);
+    ``inject`` deliberately reintroduces a known-bad configuration for
+    self-tests — 'bad-kv-spec' disables the kv-head-aligned PartitionSpec
+    rule, bringing back the GQA full-replicate resharding fallback PR 1
+    fixed (the auditor must flag it).
+    """
+
+    name: str
+    strategy: str
+    mesh_shape: Tuple[int, ...]
+    axes: Tuple[str, ...]
+    global_batch: int
+    model_family: str = "tinygpt"
+    tier: str = "S"
+    seq_len: int = 64
+    grad_accum: int = 1
+    config_overrides: Tuple[Tuple[str, Any], ...] = ()
+    inject: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ArmReport:
+    """Structured audit result for one arm — everything the budget pins."""
+
+    arm: str
+    collectives: Mapping[str, int]
+    # collective-permutes in an arm whose mesh has no >1 'seq'/'pipe' axis:
+    # rings and pipelines legitimately permute; a pure dp/tp/ep arm only
+    # emits them when the SPMD partitioner fell back to
+    # full-replicate-then-repartition resharding (the PR 1 GQA fallback
+    # lowered exactly so on this jaxlib).
+    replication_reshard_suspects: int
+    # Donation: aliased entry-parameter buffers vs donatable leaves
+    # (params + optimizer state, donate_argnums=(0, 1) in the train step).
+    donated_inputs: int
+    donatable_inputs: int
+    # bf16 -> f32 convert instructions in the module. bf16-compute arms
+    # expect a stable population (fp32 loss/accum upcasts); growth means a
+    # new unintended promotion of bf16 tensors to f32.
+    bf16_to_f32_converts: int
+
+    def to_budget_entry(self) -> Dict[str, Any]:
+        return {
+            "collectives": dict(self.collectives),
+            "replication_reshard_suspects": self.replication_reshard_suspects,
+            "donated_inputs": self.donated_inputs,
+            "donatable_inputs": self.donatable_inputs,
+            "bf16_to_f32_converts": self.bf16_to_f32_converts,
+        }
+
+
+#: The audit roster: one arm per (strategy x model-family x mesh-geometry)
+#: shape the suite roster exercises (scripts/run_all_benchmarks.sh), scaled
+#: to tier S / seq 64 so each compiles in seconds on the CPU backend. All
+#: arms assume 8 devices (the virtual-mesh test geometry).
+ROSTER: Dict[str, ArmSpec] = {
+    spec.name: spec
+    for spec in (
+        # The pure-strategy matrix at dp=8.
+        ArmSpec("ddp-dp8", "ddp", (8,), ("data",), global_batch=16),
+        ArmSpec("fsdp-dp8", "fsdp", (8,), ("data",), global_batch=16),
+        ArmSpec("zero2-dp8", "zero2", (8,), ("data",), global_batch=16),
+        ArmSpec("zero3-dp8", "zero3", (8,), ("data",), global_batch=16),
+        # llama x tensor parallel — the GQA kv-alignment arm (PR 1): a
+        # 'model' degree that does not divide the family's kv heads must
+        # NOT trip the full-replicate resharding fallback.
+        ArmSpec(
+            "llama-tp2-gqa", "ddp", (1, 1, 2), ("data", "seq", "model"),
+            global_batch=2, model_family="llama",
+        ),
+        # llama x fsdp x tp — the suite's llama-tp2 composition arm shape.
+        # NOTE: the frozen budget for this arm banks 13 reshard suspects —
+        # the fsdp('data')-sharded param layout composed with tp('model')
+        # resharding is a REAL pre-existing fallback in this composition,
+        # pinned here so it cannot GROW and so a future layout fix shows up
+        # as a bankable improvement (ROADMAP open item).
+        ArmSpec(
+            "llama-fsdp-dp4-tp2", "fsdp", (4, 1, 2), ("data", "seq", "model"),
+            global_batch=8, model_family="llama",
+        ),
+        # Sequence parallel: the ring's collective-permute hops are the
+        # budgeted schedule, not a regression.
+        ArmSpec(
+            "zero2-sp4-ring", "zero2", (1, 4, 1), ("data", "seq", "model"),
+            global_batch=2,
+            config_overrides=(("attention_impl", "ring"),),
+        ),
+        # Expert parallel: the MoE dispatch/combine all-to-alls.
+        ArmSpec(
+            "zero2-ep2-moe", "zero2", (4, 1, 1, 1, 2),
+            ("data", "seq", "model", "pipe", "expert"),
+            global_batch=16,
+            config_overrides=(("n_experts", 4),),
+        ),
+    )
+}
+
+
+def _model_config(spec: ArmSpec):
+    from ...models import get_model_config
+    from ...models.llama import get_llama_config
+
+    overrides = dict(spec.config_overrides)
+    # Dropout adds RNG ops whose count is batch-geometry noise; the audit
+    # pins the communication schedule, so arms lower dropout-free (the same
+    # choice the original HLO pin tests made).
+    overrides.setdefault("dropout", 0.0)
+    if spec.model_family == "llama":
+        return get_llama_config(spec.tier, spec.seq_len, **overrides)
+    if spec.model_family == "tinygpt":
+        return get_model_config(spec.tier, spec.seq_len, **overrides)
+    raise ValueError(
+        f"arm {spec.name!r}: unknown model_family {spec.model_family!r}"
+    )
+
+
+def lower_arm(spec: ArmSpec, devices=None):
+    """Compile the arm's train step abstractly; return the jax.stages.Compiled.
+
+    Pure compiler work — no params are initialized and no device memory is
+    allocated. Needs ``prod(mesh_shape)`` visible devices (the CLI forces
+    8 virtual CPU devices; in-process callers run under the test mesh).
+    The lowering path goes through ``train.step`` and therefore through the
+    ``utils.jax_compat`` polyfills (``jax.set_mesh`` et al.), so it stays
+    green on the image's jax 0.4.37; ``jax.sharding.AbstractMesh`` lowering
+    is not used because the collective schedule only exists in the
+    POST-partitioning executable, which requires a concrete backend to
+    build.
+    """
+    # Idempotent: a strict no-op when the package import already installed
+    # the shims or the runtime has the real APIs.
+    from ...utils import jax_compat
+
+    jax_compat.install()
+
+    import jax
+
+    from ...parallel import get_strategy, make_mesh
+    from ...train.step import abstract_compile_step
+
+    if spec.inject is not None and spec.inject not in _INJECTIONS:
+        raise ValueError(
+            f"arm {spec.name!r}: unknown injection {spec.inject!r} "
+            f"(expected one of {_INJECTIONS})"
+        )
+    if devices is None:
+        devices = jax.devices()
+    n_needed = 1
+    for d in spec.mesh_shape:
+        n_needed *= d
+    if len(devices) < n_needed:
+        raise RuntimeError(
+            f"arm {spec.name!r} needs {n_needed} devices, have "
+            f"{len(devices)} (run under "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
+    cfg = _model_config(spec)
+    mesh = make_mesh(spec.mesh_shape, spec.axes, devices=devices[:n_needed])
+    strategy = get_strategy(spec.strategy)
+
+    def compile_():
+        return abstract_compile_step(
+            cfg, strategy, mesh,
+            grad_accum=spec.grad_accum, seed=0, from_table=False,
+            global_micro=spec.global_batch, seq_len=spec.seq_len,
+        )
+
+    if spec.inject == "bad-kv-spec":
+        return _with_bad_kv_spec(compile_)
+    return compile_()
+
+
+def _with_bad_kv_spec(fn):
+    """Run ``fn`` with the kv-head-aligned PartitionSpec rule disabled.
+
+    Forcing ``kv_heads=None`` makes ``param_partition_specs`` column-shard
+    wkv/bkv over 'model' even when the degree does not divide the kv-head
+    count — the misaligned split whose consecutive-block kv repeat has no
+    in-place reshard, so GSPMD falls back to full replication (measured on
+    this jaxlib as collective-permute + all-gather chains). This is the
+    regression the llama-tp2-gqa budget exists to catch; the injection
+    exists so CI can prove the auditor catches it.
+    """
+    from ...parallel import strategies as strat
+
+    real = strat.param_partition_specs
+
+    def misaligned(params, mesh, shard, kv_heads=None):
+        return real(params, mesh, shard=shard, kv_heads=None)
+
+    strat.param_partition_specs = misaligned
+    try:
+        return fn()
+    finally:
+        strat.param_partition_specs = real
+
+
+# One instruction definition per line: "%name = <shape> <opcode>(...". The
+# instruction NAME usually embeds the opcode too (%all-gather.3), so a raw
+# substring count double-counts — anchor on the "= ... opcode(" form.
+# Tuple-shaped (variadic / async -start) definitions are counted once;
+# async -done halves are not re-counted.
+_COLLECTIVE_DEF = re.compile(
+    r"= .*?\b(" + "|".join(re.escape(op) for op in COLLECTIVE_OPS)
+    + r")(?:-start)?\("
+)
+_BF16_TO_F32_CONVERT = re.compile(r"= f32\[[^\]]*\]\S* convert\(bf16\[")
+
+
+def count_collectives(hlo_text: str) -> Dict[str, int]:
+    counts = {op: 0 for op in COLLECTIVE_OPS}
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_DEF.search(line)
+        if m:
+            counts[m.group(1)] += 1
+    return counts
+
+
+def _donatable_leaves(spec: ArmSpec) -> int:
+    """Leaf count of (params, opt_state) — the donate_argnums=(0, 1) trees."""
+    import jax
+
+    from ...models import tinygpt
+    from ...parallel import get_strategy
+    from ...parallel import strategies as strat
+    from ...train.step import _resolve_model_config
+
+    strategy = get_strategy(spec.strategy)
+    cfg = _resolve_model_config(_model_config(spec), strategy)
+    params_shape = jax.eval_shape(
+        lambda k: tinygpt.init_params(cfg, k), jax.random.key(0)
+    )
+    optimizer = strat.make_optimizer(strategy)
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    return len(jax.tree.leaves(params_shape)) + len(jax.tree.leaves(opt_shape))
+
+
+def audit_arm(spec: ArmSpec, devices=None) -> ArmReport:
+    """Lower one arm and extract its structured collective report."""
+    compiled = lower_arm(spec, devices=devices)
+    txt = compiled.as_text()
+    collectives = count_collectives(txt)
+    seq = dict(zip(spec.axes, spec.mesh_shape)).get("seq", 1)
+    pipe = dict(zip(spec.axes, spec.mesh_shape)).get("pipe", 1)
+    permutes_legit = seq > 1 or pipe > 1
+    return ArmReport(
+        arm=spec.name,
+        collectives=collectives,
+        replication_reshard_suspects=(
+            0 if permutes_legit else collectives["collective-permute"]
+        ),
+        donated_inputs=txt.count("may-alias") + txt.count("must-alias"),
+        donatable_inputs=_donatable_leaves(spec),
+        bf16_to_f32_converts=len(_BF16_TO_F32_CONVERT.findall(txt)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Budget file I/O + diffing
+# ---------------------------------------------------------------------------
+
+
+def load_budgets(path: str = DEFAULT_BUDGETS_PATH) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_budgets(
+    reports: List[ArmReport], path: str = DEFAULT_BUDGETS_PATH,
+    existing: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Freeze ``reports`` as the budget file (merging over ``existing`` so a
+    partial ``--arms`` regeneration never drops the other arms' budgets).
+    Deterministic serialization (sorted keys, fixed indent) — regenerating
+    without a real change is a byte-level no-op, so budget diffs in review
+    always mean something."""
+    import jax
+
+    doc: Dict[str, Any] = {
+        "_comment": (
+            "Frozen per-arm collective budgets — regenerate with "
+            "`python -m distributed_llm_training_benchmark_framework_tpu"
+            ".analysis.static --update-budgets` and review the diff. "
+            "Counts are pinned on the CPU backend with 8 forced host "
+            "devices; see docs/STATIC_ANALYSIS.md."
+        ),
+        "backend": "cpu",
+        "device_count": 8,
+        "jax_version": jax.__version__,
+        "arms": dict((existing or {}).get("arms", {})),
+    }
+    if existing is not None:
+        # A partial regeneration on a different jax than the file was
+        # frozen on would mix incomparable counts — and silently dropping
+        # the stale arms would break the merge promise above, so a partial
+        # regen across versions refuses with the remedy instead.
+        frozen = existing.get("jax_version")
+        if frozen is not None and frozen != jax.__version__:
+            kept = set(existing.get("arms", {}))
+            regenerated = {rep.arm for rep in reports}
+            if kept - regenerated:
+                raise ValueError(
+                    f"budgets were frozen on jax {frozen} but this is jax "
+                    f"{jax.__version__}: a partial --arms regeneration "
+                    "would mix incomparable counts — regenerate the full "
+                    f"roster (missing: {sorted(kept - regenerated)})"
+                )
+            doc["arms"] = {}
+    for rep in reports:
+        doc["arms"][rep.arm] = rep.to_budget_entry()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def diff_against_budget(
+    report: ArmReport, budgets: Dict[str, Any]
+) -> List[str]:
+    """Human-readable deltas between a fresh report and the frozen budget.
+
+    Empty list = within budget. Budgets are EXACT pins, not ceilings:
+    an improvement (fewer collectives) also fails, with wording telling
+    you to bank it via --update-budgets — otherwise the next regression
+    hides inside the slack the improvement left behind.
+    """
+    arm_budget = budgets.get("arms", {}).get(report.arm)
+    if arm_budget is None:
+        return [
+            f"{report.arm}: no frozen budget for this arm "
+            "(run --update-budgets to freeze one)"
+        ]
+    deltas: List[str] = []
+
+    def check(label: str, got: int, want: int, more_is_worse: bool = True):
+        if got == want:
+            return
+        delta = got - want
+        if (delta > 0) == more_is_worse:
+            deltas.append(
+                f"{report.arm}: {label} REGRESSED {want} -> {got} "
+                f"({delta:+d} per step)"
+            )
+        else:
+            deltas.append(
+                f"{report.arm}: {label} improved {want} -> {got} "
+                f"({delta:+d}) — bank it with --update-budgets"
+            )
+
+    for op in COLLECTIVE_OPS:
+        check(op, report.collectives.get(op, 0), arm_budget["collectives"].get(op, 0))
+    check(
+        "full-replication reshard suspects",
+        report.replication_reshard_suspects,
+        arm_budget["replication_reshard_suspects"],
+    )
+    check(
+        "donated inputs", report.donated_inputs, arm_budget["donated_inputs"],
+        more_is_worse=False,
+    )
+    check(
+        "donatable inputs", report.donatable_inputs,
+        arm_budget["donatable_inputs"], more_is_worse=False,
+    )
+    check(
+        "bf16->f32 converts", report.bf16_to_f32_converts,
+        arm_budget["bf16_to_f32_converts"],
+    )
+    return deltas

@@ -295,7 +295,7 @@ def make_train_step(
     return step_with_mesh, aot_compile
 
 
-def abstract_step_peak_bytes(
+def abstract_compile_step(
     model_config: tinygpt.TinyGPTConfig,
     strategy: strat.StrategyConfig,
     mesh: Mesh,
@@ -307,19 +307,15 @@ def abstract_step_peak_bytes(
     dataset_size: int = 64,
     pipeline_schedule: str = "gpipe",
     virtual_stages: int = 2,
-) -> Optional[int]:
-    """XLA's buffer-assignment peak for the train step, WITHOUT allocating.
+):
+    """AOT-compile the exact train-step executable from ``ShapeDtypeStruct``s.
 
-    Lowers and compiles the exact train-step executable from
-    ``ShapeDtypeStruct``s (no params are initialized, no device memory is
-    touched) and reads ``memory_analysis().peak_memory_in_bytes`` — the
-    measured compiled-program requirement, as opposed to the analytic
-    ``utils.memory.estimate_hbm`` model. Returns None when the program
-    cannot compile at all (e.g. the compiler itself reports HBM OOM) or the
-    runtime exposes no memory analysis. Used by ``resolve_auto_remat``'s
-    probe path to decide near-capacity remat policies by measurement; costs
-    one XLA compile (the result is NOT reused by the later real step, whose
-    jit cache keys on a different closure).
+    No params are initialized and no device memory is touched — the inputs
+    are abstract avals carrying their target shardings, so this is a pure
+    compiler invocation. Raises on compile failure (callers that want a
+    soft probe wrap it — see ``abstract_step_peak_bytes``). Shared by the
+    auto-remat AOT probe and the ``analysis.static`` HLO auditor, which
+    reads the compiled module's collective schedule off ``.as_text()``.
     """
     cfg = _resolve_model_config(model_config, strategy, mesh)
     optimizer = strat.make_optimizer(strategy)
@@ -374,10 +370,44 @@ def abstract_step_peak_bytes(
             (grad_accum, global_micro, seq_len), jnp.int32,
             sharding=NamedSharding(mesh, P(None, *strat.batch_partition_spec(mesh))),
         )
+    return aot_compile(params_abs, opt_abs, batch_abs, 0)
+
+
+def abstract_step_peak_bytes(
+    model_config: tinygpt.TinyGPTConfig,
+    strategy: strat.StrategyConfig,
+    mesh: Mesh,
+    grad_accum: int = 1,
+    seed: int = 0,
+    from_table: bool = True,
+    global_micro: int = 1,
+    seq_len: int = 0,
+    dataset_size: int = 64,
+    pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 2,
+) -> Optional[int]:
+    """XLA's buffer-assignment peak for the train step, WITHOUT allocating.
+
+    Lowers and compiles the exact train-step executable from
+    ``ShapeDtypeStruct``s (via ``abstract_compile_step``) and reads
+    ``memory_analysis().peak_memory_in_bytes`` — the measured
+    compiled-program requirement, as opposed to the analytic
+    ``utils.memory.estimate_hbm`` model. Returns None when the program
+    cannot compile at all (e.g. the compiler itself reports HBM OOM) or the
+    runtime exposes no memory analysis. Used by ``resolve_auto_remat``'s
+    probe path to decide near-capacity remat policies by measurement; costs
+    one XLA compile (the result is NOT reused by the later real step, whose
+    jit cache keys on a different closure).
+    """
     try:
         from ..utils import metrics as metrics_mod
 
-        compiled = aot_compile(params_abs, opt_abs, batch_abs, 0)
+        compiled = abstract_compile_step(
+            model_config, strategy, mesh, grad_accum=grad_accum, seed=seed,
+            from_table=from_table, global_micro=global_micro, seq_len=seq_len,
+            dataset_size=dataset_size, pipeline_schedule=pipeline_schedule,
+            virtual_stages=virtual_stages,
+        )
         peak = metrics_mod.buffer_assignment_peak_bytes(compiled.memory_analysis())
         return peak if peak > 0 else None
     except Exception as e:

@@ -62,10 +62,10 @@ export OFFLOAD_DPU_START_STEP="${OFFLOAD_DPU_START_STEP:-0}"
 export CAUSAL="${CAUSAL:-0}"
 export MODEL_FAMILY="${MODEL_FAMILY:-tinygpt}"
 export RING_ZIGZAG="${RING_ZIGZAG:-auto}"
-# Full flag-surface coverage (empty = harness default; the drift-detector
-# test in tests/test_distributed_runtime.py pins that every harness flag is
-# reachable from the container env, so new flags cannot silently miss the
-# k8s path).
+# Full flag-surface coverage (empty = harness default; graftcheck rule
+# GC201 — analysis/static/lint.py, pinned by tests/test_distributed_runtime
+# and run in every preflight — checks that every harness flag is reachable
+# from the container env, so new flags cannot silently miss the k8s path).
 export SEED="${SEED:-}"
 export SYNC_EVERY="${SYNC_EVERY:-}"
 export DATASET_SIZE="${DATASET_SIZE:-}"
@@ -162,6 +162,18 @@ if [ "${FLASH_BLOCKWISE_BACKWARD}" = "1" ]; then
   ARGS="${ARGS} --flash-blockwise-backward"; fi
 if [ "${RESUME}" = "1" ]; then ARGS="${ARGS} --resume"; fi
 if [ "${DEBUG}" = "1" ]; then ARGS="${ARGS} --debug"; fi
+
+# GRAFTCHECK=1: run the static preflight (collective-budget audit + lint,
+# scripts/graftcheck.sh) before launching. Runs on the container's host CPU
+# (the tool pins its own CPU backend), so a sharding regression in the image
+# fails the pod in seconds instead of burning slice time. Off by default:
+# multi-host launches would redundantly audit once per worker.
+export GRAFTCHECK="${GRAFTCHECK:-0}"
+if [ "${GRAFTCHECK}" = "1" ]; then
+  echo "=== Preflight: graftcheck static analysis ==="
+  /app/scripts/graftcheck.sh || exit 1
+  echo ""
+fi
 if [[ "${SYNTHETIC}" == "true" ]]; then ARGS="${ARGS} --synthetic"; fi
 if [[ "${STRATEGY}" == "zero2" || "${STRATEGY}" == "zero3" ]]; then
   ARGS="${ARGS} --strategy-config /app/configs/strategies/${STRATEGY}.json"

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# graftcheck wrapper: the static preflight, runnable standalone (the k8s
+# image carries it via the scripts/ COPY) and called by bench.py and
+# run_all_benchmarks.sh before any TPU time is spent.
+#
+# No args = both engines over the full arm roster; any args are passed
+# through to the CLI (e.g. `scripts/graftcheck.sh --lint`, or
+# `--audit --arms llama-tp2-gqa`). The CLI pins JAX_PLATFORMS=cpu and the
+# 8-virtual-device geometry itself, so this is safe to run inside a TPU
+# container or beside a TPU process — it never touches the chips.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m distributed_llm_training_benchmark_framework_tpu.analysis.static "${@:---all}"

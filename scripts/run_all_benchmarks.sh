@@ -73,6 +73,12 @@ COMPOSITIONS="${COMPOSITIONS:-auto}"
 # + composition roster against a faked device count). Analysis/validation
 # are skipped too (there is nothing to analyze).
 SUITE_DRY_RUN="${SUITE_DRY_RUN:-0}"
+# Static preflight (graftcheck: per-arm collective-budget audit + lint) runs
+# before any benchmark launches, so a sharding/donation regression fails in
+# seconds on the host CPU instead of after a paid multi-chip matrix.
+# SKIP_PREFLIGHT=1 bypasses (same escape hatch as bench.py's
+# --skip-preflight); dry runs plan only and skip it too.
+SKIP_PREFLIGHT="${SKIP_PREFLIGHT:-0}"
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -114,6 +120,14 @@ echo "=== TPU Benchmark Suite ==="
 echo "mode=$MODE strategies=[$STRATEGIES] world_sizes=[$WORLD_SIZES] attention=$ATTENTION"
 echo "tier=$TIER seq=$SEQ_LEN steps=$STEPS batch=$PER_DEVICE_BATCH accum=$GRAD_ACCUM"
 echo ""
+
+if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_PREFLIGHT" != "1" ]; then
+  echo "=== Preflight: graftcheck static analysis ==="
+  scripts/graftcheck.sh \
+    || { echo "PREFLIGHT FAILED — no arms launched (SKIP_PREFLIGHT=1 to" \
+              "override)"; exit 1; }
+  echo ""
+fi
 
 PASS=0; FAIL=0
 SUITE_START=$(date +%s)

@@ -206,44 +206,42 @@ def test_entrypoint_extended_knobs_reach_argv(tmp_path):
     assert "--resume" in joined
 
 
-# Harness flags deliberately NOT reachable from the container env, with the
-# reason each is exempt from the drift detector below:
-#   --local-rank        accepted for reference-CLI parity only; device
-#                       selection is mesh-driven on TPU (harness help text)
-#   --deepspeed-config  alias of --strategy-config, which the entrypoint
-#   --fsdp-config       already sets for the ZeRO arms
-ENTRYPOINT_EXEMPT_FLAGS = {"--local-rank", "--deepspeed-config", "--fsdp-config"}
-
-
 def test_entrypoint_covers_harness_flag_surface():
     """Drift detector: the env-var contract in docker/entrypoint.sh must
-    cover ``train/harness.py::build_parser()``'s flag surface exactly
-    (modulo the documented exemptions above), in BOTH directions — a flag
-    added to the harness cannot silently miss the container path, and the
-    entrypoint cannot carry a stale/renamed flag the harness would reject.
+    cover ``train/harness.py::build_parser()``'s flag surface exactly, in
+    BOTH directions — a flag added to the harness cannot silently miss the
+    container path, and the entrypoint cannot carry a stale/renamed flag
+    the harness would reject.
+
+    The detector itself now lives in the graftcheck rule registry as GC201
+    (``analysis/static/lint.py`` — one registry, one CLI, one suppression
+    syntax; the documented exemptions moved to
+    ``lint.ENTRYPOINT_EXEMPT_FLAGS``), so this test pins that the rule
+    runs clean on HEAD rather than re-implementing the comparison.
     """
-    import re
-
-    from distributed_llm_training_benchmark_framework_tpu.train.harness import (
-        build_parser,
+    from distributed_llm_training_benchmark_framework_tpu.analysis.static import (
+        lint,
     )
 
-    parser_flags = set()
-    for action in build_parser()._actions:
-        parser_flags.update(
-            o for o in action.option_strings if o.startswith("--")
-        )
-    parser_flags.discard("--help")
+    violations = lint.run_lint(rules=("GC201",))
+    assert not violations, "\n".join(str(v) for v in violations)
 
-    text = open(ENTRYPOINT).read()
-    entry_flags = set(re.findall(r"--[a-z][a-z0-9-]+", text))
 
-    stale = entry_flags - parser_flags
-    assert not stale, (
-        f"entrypoint.sh passes flags the harness does not define: {sorted(stale)}"
+def test_entrypoint_drift_rule_fires_both_directions(tmp_path):
+    """GC201 must actually detect drift — a stale entrypoint flag and a
+    missing harness flag each produce a violation against a doctored
+    entrypoint in a scratch repo root (package source untouched)."""
+    from distributed_llm_training_benchmark_framework_tpu.analysis.static import (
+        lint,
     )
-    missing = parser_flags - entry_flags - ENTRYPOINT_EXEMPT_FLAGS
-    assert not missing, (
-        "harness flags with no container-env plumbing in entrypoint.sh "
-        f"(add an env var or an explicit exemption): {sorted(missing)}"
-    )
+
+    (tmp_path / "docker").mkdir()
+    doctored = open(ENTRYPOINT).read().replace(
+        "--strategy ${STRATEGY}", "--strategy ${STRATEGY} --no-such-flag 1"
+    ).replace("--seq-len ${SEQ_LEN} ", "")
+    (tmp_path / "docker" / "entrypoint.sh").write_text(doctored)
+    violations = lint.run_lint(root=str(tmp_path), rules=("GC201",))
+    stale = [v for v in violations if "--no-such-flag" in v.message]
+    missing = [v for v in violations if "--seq-len" in v.message]
+    assert stale and missing, violations
+    assert all(v.rule_id == "GC201" for v in violations)

@@ -1,0 +1,357 @@
+"""graftcheck (analysis.static) tier-1 coverage: both engines on CPU.
+
+Three layers, cheapest first:
+
+- diff-logic unit tests against the hand-written frozen fixture budgets
+  (``tests/fixtures/graftcheck_budgets_frozen.json``) — no compiles;
+- lint-rule behavior against scratch repo roots (each rule must fire on a
+  doctored tree, honor the ``# graftcheck: disable=`` pragma, and run
+  clean on HEAD);
+- the HLO auditor end-to-end on a roster subset against the LIVE budgets
+  in ``configs/collective_budgets.json`` (HEAD must be within budget), the
+  deliberate bad-PartitionSpec injection (the auditor must flag the GQA
+  full-replicate fallback), and ``--update-budgets`` round-trip stability
+  (regenerate -> diff clean -> regenerate again is byte-identical).
+"""
+
+import dataclasses
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from distributed_llm_training_benchmark_framework_tpu.analysis.static import (
+    hlo_audit,
+    lint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_BUDGETS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures",
+    "graftcheck_budgets_frozen.json",
+)
+PKG = "distributed_llm_training_benchmark_framework_tpu"
+
+
+# ---------------------------------------------------------------------------
+# Budget diff logic (frozen fixture, no compiles)
+# ---------------------------------------------------------------------------
+
+
+def _fixture_report(**overrides):
+    base = dict(
+        arm="fixture-arm",
+        collectives={
+            "all-gather": 4, "reduce-scatter": 2, "all-reduce": 7,
+            "collective-permute": 0, "all-to-all": 0,
+        },
+        replication_reshard_suspects=0,
+        donated_inputs=12,
+        donatable_inputs=12,
+        bf16_to_f32_converts=10,
+    )
+    base.update(overrides)
+    return hlo_audit.ArmReport(**base)
+
+
+@pytest.fixture(scope="module")
+def fixture_budgets():
+    return hlo_audit.load_budgets(FIXTURE_BUDGETS)
+
+
+def test_within_budget_is_clean(fixture_budgets):
+    assert hlo_audit.diff_against_budget(_fixture_report(), fixture_budgets) == []
+
+
+def test_collective_regression_is_named_with_delta(fixture_budgets):
+    rep = _fixture_report(collectives={
+        "all-gather": 6, "reduce-scatter": 2, "all-reduce": 7,
+        "collective-permute": 0, "all-to-all": 0,
+    })
+    deltas = hlo_audit.diff_against_budget(rep, fixture_budgets)
+    assert len(deltas) == 1
+    # The failure names the arm, the collective, and the budget delta.
+    assert "fixture-arm" in deltas[0]
+    assert "all-gather" in deltas[0]
+    assert "REGRESSED 4 -> 6" in deltas[0] and "+2" in deltas[0]
+
+
+def test_improvement_also_fails_but_says_bank_it(fixture_budgets):
+    rep = _fixture_report(collectives={
+        "all-gather": 3, "reduce-scatter": 2, "all-reduce": 7,
+        "collective-permute": 0, "all-to-all": 0,
+    })
+    deltas = hlo_audit.diff_against_budget(rep, fixture_budgets)
+    assert len(deltas) == 1
+    assert "improved" in deltas[0] and "--update-budgets" in deltas[0]
+
+
+def test_lost_donation_is_a_regression(fixture_budgets):
+    deltas = hlo_audit.diff_against_budget(
+        _fixture_report(donated_inputs=10), fixture_budgets
+    )
+    assert len(deltas) == 1
+    assert "donated inputs REGRESSED" in deltas[0]
+
+
+def test_unknown_arm_demands_a_budget(fixture_budgets):
+    deltas = hlo_audit.diff_against_budget(
+        _fixture_report(arm="never-frozen"), fixture_budgets
+    )
+    assert deltas and "no frozen budget" in deltas[0]
+
+
+# ---------------------------------------------------------------------------
+# Lint rules (scratch roots + HEAD)
+# ---------------------------------------------------------------------------
+
+
+def test_lint_is_clean_on_head():
+    violations = lint.run_lint()
+    assert violations == [], "\n".join(str(v) for v in violations)
+
+
+def test_rule_catalog_is_complete():
+    assert set(lint.RULES) == {"GC101", "GC102", "GC103", "GC104", "GC201"}
+    for rule in lint.RULES.values():
+        assert rule.fix_hint and rule.description
+
+
+def _scratch_root(tmp_path, rel, source):
+    path = tmp_path / PKG / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return str(tmp_path)
+
+
+def test_gc101_fires_on_undonated_jit_and_honors_suppression(tmp_path):
+    root = _scratch_root(tmp_path, "train/scratch.py", """\
+        import jax
+
+        def bad(f, x):
+            return jax.jit(f)(x)
+
+        def sanctioned(f, x):
+            return jax.jit(f)(x)  # graftcheck: disable=GC101
+
+        def fine(f, x, sh):
+            return jax.jit(f, out_shardings=sh)(x)
+    """)
+    violations = lint.run_lint(root=root, rules=("GC101",))
+    assert [v.line for v in violations] == [4]
+    assert violations[0].rule_id == "GC101"
+    assert "donate" in violations[0].fix_hint
+
+
+def test_gc102_fires_on_host_sync_in_timed_loop(tmp_path):
+    root = _scratch_root(tmp_path, "train/loop.py", """\
+        def run(steps, step_fn, state):
+            losses = []
+            for step in range(steps):
+                state, loss = step_fn(state, step)
+                losses.append(float(loss))
+            return losses
+    """)
+    violations = lint.run_lint(root=root, rules=("GC102",))
+    assert len(violations) == 1 and violations[0].line == 5
+    assert "host sync" in violations[0].message
+
+
+def test_gc102_ignores_syncs_in_nested_window_helpers(tmp_path):
+    root = _scratch_root(tmp_path, "train/loop.py", """\
+        def run(steps, step_fn, state):
+            pending = []
+
+            def sync_window():
+                return [float(l) for l in pending]
+
+            for step in range(steps):
+                state, loss = step_fn(state, step)
+                pending.append(loss)
+            return sync_window()
+    """)
+    assert lint.run_lint(root=root, rules=("GC102",)) == []
+
+
+def test_gc103_fires_on_unknown_axis(tmp_path):
+    _scratch_root(tmp_path, "parallel/mesh.py", """\
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class MeshAxes:
+            data: str = "data"
+            model: str = "model"
+    """)
+    root = _scratch_root(tmp_path, "train/scratch.py", """\
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def constrain(x):
+            x = lax.with_sharding_constraint(x, P("data", "modle"))
+            return lax.with_sharding_constraint(x, P(None, "model"))
+    """)
+    violations = lint.run_lint(root=root, rules=("GC103",))
+    assert len(violations) == 1
+    assert "'modle'" in violations[0].message
+    assert "data" in violations[0].message  # known axes listed in the finding
+
+
+def test_gc104_fires_on_time_time(tmp_path):
+    root = _scratch_root(tmp_path, "ops/scratch.py", """\
+        import time
+
+        def kernel_host_wrap():
+            t0 = time.time()
+            return time.perf_counter() - t0
+    """)
+    violations = lint.run_lint(root=root, rules=("GC104",))
+    assert [v.line for v in violations] == [4]
+
+
+def test_suppression_accepts_lists_and_all(tmp_path):
+    root = _scratch_root(tmp_path, "models/scratch.py", """\
+        import jax
+
+        def a(f, x):
+            # graftcheck: disable=GC104, GC101
+            return jax.jit(f)(x)
+
+        def b(f, x):
+            return jax.jit(f)(x)  # graftcheck: disable=all
+    """)
+    assert lint.run_lint(root=root, rules=("GC101",)) == []
+
+
+# ---------------------------------------------------------------------------
+# HLO auditor end-to-end (CPU compiles, roster subset)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gqa_report(eight_devices):
+    return hlo_audit.audit_arm(hlo_audit.ROSTER["llama-tp2-gqa"])
+
+
+def test_head_is_within_frozen_budget(gqa_report, eight_devices):
+    budgets = hlo_audit.load_budgets()
+    reports = [gqa_report, hlo_audit.audit_arm(hlo_audit.ROSTER["ddp-dp8"])]
+    deltas = [
+        d for rep in reports
+        for d in hlo_audit.diff_against_budget(rep, budgets)
+    ]
+    assert deltas == [], "\n".join(deltas)
+
+
+def test_roster_covers_strategy_family_and_geometry_axes():
+    strategies = {s.strategy for s in hlo_audit.ROSTER.values()}
+    families = {s.model_family for s in hlo_audit.ROSTER.values()}
+    geometries = {s.mesh_shape for s in hlo_audit.ROSTER.values()}
+    assert {"ddp", "fsdp", "zero2", "zero3"} <= strategies
+    assert families == {"tinygpt", "llama"}
+    assert len(geometries) >= 4  # dp, tp, sp, ep shapes at minimum
+    budgets = hlo_audit.load_budgets()
+    assert set(budgets["arms"]) == set(hlo_audit.ROSTER), (
+        "configs/collective_budgets.json out of sync with the roster — "
+        "run --update-budgets"
+    )
+
+
+def test_injected_bad_kv_spec_is_flagged(gqa_report, eight_devices):
+    """The acceptance regression: deliberately reintroduce the PR 1 GQA
+    kv full-replicate resharding (misaligned 'model' split of wkv/bkv) and
+    the auditor must fail the arm, naming the collective and the delta."""
+    bad = dataclasses.replace(
+        hlo_audit.ROSTER["llama-tp2-gqa"], inject="bad-kv-spec"
+    )
+    rep = hlo_audit.audit_arm(bad)
+    assert rep.collectives["collective-permute"] > 0
+    assert rep.replication_reshard_suspects > 0
+    # The clean arm stays clean — the injection is what flipped it.
+    assert gqa_report.collectives["collective-permute"] == 0
+    deltas = hlo_audit.diff_against_budget(rep, hlo_audit.load_budgets())
+    joined = "\n".join(deltas)
+    assert "llama-tp2-gqa" in joined
+    assert "collective-permute REGRESSED" in joined
+
+
+def test_update_budgets_round_trip_is_stable(gqa_report, tmp_path):
+    path = str(tmp_path / "budgets.json")
+    hlo_audit.write_budgets([gqa_report], path)
+    budgets = hlo_audit.load_budgets(path)
+    # Regenerating from the same report diffs clean...
+    assert hlo_audit.diff_against_budget(gqa_report, budgets) == []
+    first = open(path).read()
+    # ...and re-freezing (merge over the existing file) is byte-identical:
+    # budget diffs in review always mean a real schedule change.
+    hlo_audit.write_budgets([gqa_report], path, existing=budgets)
+    assert open(path).read() == first
+
+
+def test_partial_update_preserves_other_arms(gqa_report, tmp_path):
+    path = str(tmp_path / "budgets.json")
+    live = hlo_audit.load_budgets()
+    hlo_audit.write_budgets([gqa_report], path, existing=live)
+    merged = hlo_audit.load_budgets(path)
+    # A partial --arms regeneration must not drop the rest of the roster.
+    assert set(merged["arms"]) == set(live["arms"])
+
+
+def test_partial_update_across_jax_versions_is_refused(tmp_path, fixture_budgets):
+    # The fixture file was "frozen" on jax 0.0.0-fixture and carries an arm
+    # the regeneration does not cover — silently dropping it would mix
+    # incomparable counts into one file, so write_budgets must refuse.
+    path = str(tmp_path / "budgets.json")
+    with pytest.raises(ValueError, match="regenerate the full roster"):
+        hlo_audit.write_budgets(
+            [_fixture_report(arm="some-other-arm")], path,
+            existing=fixture_budgets,
+        )
+    # Covering every frozen arm IS a full regeneration: allowed, and the
+    # stale-version counts are replaced rather than merged.
+    hlo_audit.write_budgets([_fixture_report()], path, existing=fixture_budgets)
+    assert set(hlo_audit.load_budgets(path)["arms"]) == {"fixture-arm"}
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", f"{PKG}.analysis.static", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+    )
+
+
+def test_cli_lint_exits_zero_on_head():
+    proc = _cli("--lint")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "graftcheck lint: clean" in proc.stderr
+
+
+def test_cli_rejects_unknown_arm():
+    proc = _cli("--audit", "--arms", "no-such-arm")
+    assert proc.returncode == 2
+    assert "unknown arm" in proc.stderr
+
+
+def test_cli_refuses_to_freeze_injected_budgets():
+    # --inject + --update-budgets would pin the deliberately-bad schedule
+    # as the audited baseline; the CLI must refuse before any compile.
+    proc = _cli("--update-budgets", "--inject", "bad-kv-spec")
+    assert proc.returncode == 2
+    assert "cannot be combined" in proc.stderr
+
+
+def test_cli_lists_roster_and_rules():
+    proc = _cli("--list-arms")
+    assert proc.returncode == 0
+    for name in hlo_audit.ROSTER:
+        assert name in proc.stdout
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rule_id in lint.RULES:
+        assert rule_id in proc.stdout
