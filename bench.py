@@ -136,6 +136,13 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
         "tokens_per_dollar": (
             round(result.tokens_per_dollar) if result.tokens_per_dollar else None
         ),
+        # Flight-recorder phase attribution (telemetry.TelemetryRecorder):
+        # where this arm's wall time went — compile vs timed is the number
+        # that explains a slow bench.py invocation at a glance.
+        "wall_time_total_sec": round(result.wall_time_total_sec, 2),
+        "time_in_compile_sec": round(result.time_in_compile_sec, 2),
+        "time_in_timed_sec": round(result.time_in_timed_sec, 2),
+        "n_anomalies": result.n_anomalies,
     }
 
 

@@ -133,6 +133,16 @@ def build_report(
         # estimate instead of an all-zero measured column.
         cols = [c for c in cols if c != "peak_vram_gb"]
         cols.insert(-1, "est_hbm_gb")
+    # Partial rows (heartbeat salvage from runs that died before their
+    # final marker — scripts/collect_results.sh): kept in the tables with
+    # an explicit flag column, excluded from the key-findings superlatives
+    # (a truncated run's throughput is not a best-of anything).
+    has_partial = "partial" in df.columns and df["partial"].fillna(False).any()
+    if has_partial:
+        cols.append("partial")
+        full = df[~df["partial"].fillna(False).astype(bool)]
+    else:
+        full = df
     cols = [c for c in cols if c in df.columns]
     out = ["# TPU Distributed Training Benchmark Report", ""]
 
@@ -149,14 +159,15 @@ def build_report(
                 fmt_table(g[cols], cols), ""]
 
     out += ["## Key findings", ""]
-    best_tps = df.loc[df["tokens_per_sec"].idxmax()]
-    out.append(
-        f"- **Best throughput:** {best_tps['strategy']} at "
-        f"{best_tps['tokens_per_sec']:,.0f} tokens/sec "
-        f"({int(best_tps['world_size'])} chips, seq {int(best_tps['seq_len'])})"
-    )
-    if "scaling_efficiency_pct" in df.columns and len(df) > 1:
-        multi = df[df["world_size"] > df["world_size"].min()]
+    if len(full):
+        best_tps = full.loc[full["tokens_per_sec"].idxmax()]
+        out.append(
+            f"- **Best throughput:** {best_tps['strategy']} at "
+            f"{best_tps['tokens_per_sec']:,.0f} tokens/sec "
+            f"({int(best_tps['world_size'])} chips, seq {int(best_tps['seq_len'])})"
+        )
+    if "scaling_efficiency_pct" in full.columns and len(full) > 1:
+        multi = full[full["world_size"] > full["world_size"].min()]
         if len(multi):
             best_eff = multi.loc[multi["scaling_efficiency_pct"].idxmax()]
             out.append(
@@ -164,31 +175,38 @@ def build_report(
                 f"{best_eff['scaling_efficiency_pct']:.1f}% "
                 f"({int(best_eff['world_size'])} chips)"
             )
-    if df["peak_vram_gb"].max() > 0:
-        low_mem = df.loc[df["peak_vram_gb"].idxmin()]
+    if "peak_vram_gb" in full.columns and full["peak_vram_gb"].max() > 0:
+        low_mem = full.loc[full["peak_vram_gb"].idxmin()]
         out.append(
             f"- **Lowest peak HBM:** {low_mem['strategy']} at "
             f"{low_mem['peak_vram_gb']:.2f} GB/chip"
         )
-    if "mfu_pct" in df.columns and (df["mfu_pct"] > 0).any():
-        best_mfu = df.loc[df["mfu_pct"].idxmax()]
+    if "mfu_pct" in full.columns and (full["mfu_pct"] > 0).any():
+        best_mfu = full.loc[full["mfu_pct"].idxmax()]
         impl = (
             f", {best_mfu['attention_impl']} attention"
-            if "attention_impl" in df.columns else ""
+            if "attention_impl" in full.columns else ""
         )
         out.append(
             f"- **Best MFU:** {best_mfu['strategy']} at "
             f"{best_mfu['mfu_pct']:.1f}% of bf16 peak"
             f" (seq {int(best_mfu['seq_len'])}{impl})"
         )
-    if "tokens_per_dollar" in df.columns and (df["tokens_per_dollar"] > 0).any():
+    if "tokens_per_dollar" in full.columns and (full["tokens_per_dollar"] > 0).any():
         # Cost-efficiency headline (reference README.md:270-276 analogue).
-        best_cost = df.loc[df["tokens_per_dollar"].idxmax()]
+        best_cost = full.loc[full["tokens_per_dollar"].idxmax()]
         out.append(
             f"- **Best cost efficiency:** {best_cost['strategy']} at "
             f"{best_cost['tokens_per_dollar']/1e6:,.1f}M tokens/$ "
             f"(${best_cost['usd_per_chip_hour']:.2f}/chip-hr on-demand, "
             f"seq {int(best_cost['seq_len'])})"
+        )
+    if has_partial:
+        n_partial = int(df["partial"].fillna(False).astype(bool).sum())
+        out.append(
+            f"- **Partial rows:** {n_partial} arm(s) died before their "
+            "final result marker; their rows come from heartbeat salvage "
+            "(last sync window) — see the `partial` column."
         )
     out.append("")
 

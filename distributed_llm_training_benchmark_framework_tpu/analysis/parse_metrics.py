@@ -38,6 +38,42 @@ REFERENCE_COLUMNS = [
 ]
 
 
+def _partial_row(p: dict) -> dict:
+    """Map a salvaged heartbeat payload (collect_results.sh
+    ``partial_<arm>.json``) onto the result-row column space.
+
+    A dead arm's last heartbeat carries its run identity plus the
+    progress metrics at its final sync window; mapping them here is what
+    makes failed arms appear in metrics.csv/the report as visibly-partial
+    rows instead of vanishing. Metrics the heartbeat cannot know (peak
+    memory, MFU, ...) stay absent -> NaN in the frame.
+    """
+    row = {
+        k: p[k] for k in (
+            "strategy", "world_size", "rank", "seq_len", "tier",
+            "model_family", "per_device_batch", "grad_accum",
+            "tokens_per_sec",
+            # Composition axes (in the heartbeat meta since round 8): keep
+            # partial rows from colliding arms — e.g. the zigzag A/B pair —
+            # distinct under the dedup key below.
+            "attention_impl", "tensor_parallel", "sequence_parallel",
+            "pipeline_parallel", "pipeline_schedule", "expert_parallel",
+            "n_experts", "causal", "ring_zigzag",
+        ) if k in p
+    }
+    if "total_steps" in p:
+        row["steps"] = p["total_steps"]
+    if "window_mean_step_time_sec" in p:
+        row["mean_step_time_sec"] = p["window_mean_step_time_sec"]
+    if "loss" in p and p["loss"] is not None:
+        # The LAST observed loss, not a run mean — close enough for a
+        # partial row, and the partial flag warns every consumer.
+        row["mean_loss"] = p["loss"]
+    row["last_step"] = p.get("step")
+    row["partial"] = True
+    return row
+
+
 def load_results(results_dir: str) -> pd.DataFrame:
     rows = []
     for path in sorted(Path(results_dir).rglob("result*.json")):
@@ -46,8 +82,20 @@ def load_results(results_dir: str) -> pd.DataFrame:
                 rows.append(json.load(f))
         except (json.JSONDecodeError, OSError) as e:
             print(f"WARNING: skipping unreadable {path}: {e}")
+    n_full = len(rows)
+    for path in sorted(Path(results_dir).rglob("partial_*.json")):
+        try:
+            with open(path) as f:
+                rows.append(_partial_row(json.load(f)))
+        except (json.JSONDecodeError, OSError) as e:
+            print(f"WARNING: skipping unreadable {path}: {e}")
     if not rows:
         raise SystemExit(f"No result*.json files found under {results_dir}")
+    if len(rows) > n_full:
+        print(f"NOTE: {len(rows) - n_full} partial row(s) from heartbeat "
+              "salvage (runs that died before their final result marker)")
+        for r in rows[:n_full]:
+            r.setdefault("partial", False)
     df = pd.DataFrame(rows)
     # The same run can surface twice: the harness writes result_<arm>.json and
     # the log scraper extracts result.json for the identical run. Dedupe on
@@ -94,10 +142,20 @@ def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
     ]
     df = df.copy()
     df["scaling_efficiency_pct"] = 0.0
+    # Partial rows (heartbeat salvage): a truncated run's throughput must
+    # neither serve as a group baseline nor mint an efficiency number of
+    # its own — its last-window rate is not a run mean. NaN marks the cell
+    # as not-measured (0.0 would read as a catastrophic measurement).
+    if "partial" in df.columns:
+        is_partial = df["partial"].fillna(False).astype(bool)
+        df.loc[is_partial, "scaling_efficiency_pct"] = float("nan")
+        eligible = df[~is_partial]
+    else:
+        eligible = df
     # dropna=False: rows from before a schema addition carry NaN in the
     # newer axis columns and must still get their efficiency computed
     # (pandas silently drops NaN-keyed groups by default).
-    for _, group in df.groupby(group_cols, dropna=False):
+    for _, group in eligible.groupby(group_cols, dropna=False):
         base = group.loc[group["world_size"].idxmin()]
         for i in group.index:
             row = df.loc[i]

@@ -26,19 +26,64 @@ import gzip
 import json
 import os
 import re
+import sys
 from typing import Dict, List, Optional, Tuple
 
 
-def find_trace_file(profile_dir: str) -> Optional[str]:
-    """Newest Chrome-trace file under a jax.profiler output directory."""
-    patterns = (
-        os.path.join(profile_dir, "plugins", "profile", "*", "*.trace.json.gz"),
-        os.path.join(profile_dir, "*.trace.json.gz"),
-    )
-    hits: List[str] = []
-    for p in patterns:
-        hits.extend(glob.glob(p))
-    return max(hits, key=os.path.getmtime) if hits else None
+def list_profile_runs(profile_dir: str) -> List[Tuple[str, str]]:
+    """All (run_name, newest trace file) pairs under a profiler directory.
+
+    jax.profiler writes one ``plugins/profile/<run>/`` directory per
+    ``start_trace`` call, so a profile dir reused across benchmark arms
+    holds several runs. Sorted oldest-first by trace mtime; bare traces at
+    the top level (non-standard layouts) appear under run name ``'.'``.
+    """
+    per_run: Dict[str, str] = {}
+    for f in glob.glob(
+        os.path.join(profile_dir, "plugins", "profile", "*", "*.trace.json.gz")
+    ):
+        run = os.path.basename(os.path.dirname(f))
+        if run not in per_run or os.path.getmtime(f) > os.path.getmtime(per_run[run]):
+            per_run[run] = f
+    for f in glob.glob(os.path.join(profile_dir, "*.trace.json.gz")):
+        if "." not in per_run or os.path.getmtime(f) > os.path.getmtime(per_run["."]):
+            per_run["."] = f
+    return sorted(per_run.items(), key=lambda kv: os.path.getmtime(kv[1]))
+
+
+def find_trace_file(profile_dir: str, run: Optional[str] = None) -> Optional[str]:
+    """Chrome-trace file under a jax.profiler output directory.
+
+    With one run present (the common case) its trace is returned. A
+    profile dir reused across several runs used to silently yield the
+    globally newest trace — an operator summarizing arm A after re-running
+    arm B got B's trace under A's name. Now: ``run`` selects by run-dir
+    name (exact, then unique substring; ValueError naming the candidates
+    otherwise), and with no selector the newest run is still returned but
+    the ambiguity is WARNED on stderr with the candidate list.
+    """
+    runs = list_profile_runs(profile_dir)
+    if not runs:
+        return None
+    if run is not None:
+        exact = [f for name, f in runs if name == run]
+        if exact:
+            return exact[0]
+        sub = [(name, f) for name, f in runs if run in name]
+        if len(sub) == 1:
+            return sub[0][1]
+        raise ValueError(
+            f"--run {run!r} matches {len(sub)} of the profile runs in "
+            f"{profile_dir}; candidates: {[name for name, _ in runs]}"
+        )
+    if len(runs) > 1:
+        print(
+            f"WARNING: {profile_dir} holds {len(runs)} profile runs; "
+            "summarizing the newest. Pass --run <name> to pick one of: "
+            + ", ".join(name for name, _ in runs),
+            file=sys.stderr,
+        )
+    return runs[-1][1]
 
 
 def load_events(trace_file: str) -> List[dict]:
@@ -136,8 +181,16 @@ def main(argv=None) -> int:
                    help="the directory passed to the harness's --profile-dir")
     p.add_argument("--top", type=int, default=15,
                    help="individual ops to list with provenance")
+    p.add_argument("--run", default=None,
+                   help="profile run directory name (or unique substring) "
+                        "when --profile-dir holds several runs; default: "
+                        "newest, with a warning listing the candidates")
     args = p.parse_args(argv)
-    trace = find_trace_file(args.profile_dir)
+    try:
+        trace = find_trace_file(args.profile_dir, run=args.run)
+    except ValueError as e:
+        print(f"ERROR: {e}")
+        return 1
     if trace is None:
         print(f"ERROR: no *.trace.json.gz under {args.profile_dir} "
               "(did the run include --profile-dir and >= warmup steps?)")

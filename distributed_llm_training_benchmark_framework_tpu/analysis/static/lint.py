@@ -18,6 +18,12 @@ deployment invariant this codebase has already paid for once:
 - GC104  ``time.time()`` in jit-adjacent modules (``train/``, ``models/``,
          ``ops/``, ``parallel/``): under trace it constant-folds to the
          trace-time clock; host-side timing uses ``time.perf_counter``.
+- GC105  telemetry/file-IO/print calls inside the timed ``for step`` loop
+         of ``train/loop.py`` that are not fenced at a ``sync_window``
+         boundary: the flight recorder (telemetry/) writes JSONL and
+         heartbeats, and the ONLY sanctioned cadence is the sync-window
+         boundary — unfenced host IO mid-window lands inside the very
+         step times the loop publishes.
 - GC201  entrypoint<->harness flag-surface drift (PR 1's detector, now a
          registry rule): every ``train/harness.py`` flag must be reachable
          from the container env in ``docker/entrypoint.sh`` and vice versa.
@@ -236,6 +242,130 @@ def _check_timed_loop_syncs(root: str) -> Iterator[Violation]:
                     "timed step loop",
                     RULES["GC102"].fix_hint,
                 )
+
+
+# ---------------------------------------------------------------------------
+# GC105: unfenced telemetry / file IO / prints in the timed loop
+# ---------------------------------------------------------------------------
+
+
+def _is_telemetry_io_call(call: ast.Call) -> Optional[str]:
+    """Classify a call as loop-hostile IO, or None.
+
+    Targets: ``print``/``open``/``os.write``/``json.dump``, any
+    ``*.write()``/``.writelines()``/``.flush()`` method, and any call on a
+    receiver whose name mentions ``recorder``/``telemetry`` (the flight
+    recorder's surface). Device work and pure bookkeeping stay out of
+    scope — the rule polices host IO cadence, not computation.
+    """
+    name = _dotted(call.func)
+    if name in ("print", "open", "os.write", "json.dump", "json.dumps"):
+        # json.dumps is not IO itself, but in the timed loop it only ever
+        # exists to feed a write — flag the serialization too.
+        return f"{name}() host IO"
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr in ("write", "writelines", "flush"):
+            return f".{call.func.attr}() file IO"
+        recv = _dotted(call.func.value) or ""
+        if "recorder" in recv.lower() or "telemetry" in recv.lower():
+            return f"telemetry call {recv}.{call.func.attr}()"
+    return None
+
+
+@_rule(
+    "GC105",
+    "unfenced-telemetry-io-in-timed-loop",
+    "telemetry/file-IO/print call inside the timed `for step` loop of "
+    "train/loop.py with no sync_window fence earlier in its block — host "
+    "IO mid-window skews the very step times the loop publishes",
+    "emit telemetry from inside sync_window (the sanctioned boundary), or "
+    "place the call after a sync_window(...) fence in the same block; "
+    "suppress deliberate exceptions with '# graftcheck: disable=GC105'",
+)
+def _check_timed_loop_telemetry_io(root: str) -> Iterator[Violation]:
+    path = os.path.join(root, PACKAGE, "train", "loop.py")
+    if not os.path.exists(path):
+        return
+    tree = _Tree(path, os.path.relpath(path, root))
+
+    def timed_loops(node):
+        for n in ast.walk(node):
+            if (
+                isinstance(n, ast.For)
+                and isinstance(n.target, ast.Name)
+                and n.target.id == "step"
+            ):
+                yield n
+
+    def contains_sync(node) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and _dotted(n.func) in (
+                "sync_window", "self.sync_window"
+            ):
+                return True
+        return False
+
+    def stmt_calls(stmt):
+        """IO calls directly in ``stmt``, excluding nested function defs
+        (sync_window-style helpers are the sanctioned boundary itself)."""
+        stack = [stmt]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Call):
+                yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    def walk_block(stmts, fenced: bool) -> Iterator[Violation]:
+        """Statement-ordered traversal with a per-block fence flag.
+
+        A statement whose subtree calls ``sync_window`` fences everything
+        AFTER it in the same block (and in blocks nested under those later
+        statements); compound statements pass the current flag down to
+        their bodies. Conservative in the right direction: a fence from a
+        previous loop iteration never carries over.
+        """
+        for stmt in stmts:
+            if not fenced:
+                if isinstance(stmt, (ast.If, ast.With, ast.Try, ast.For,
+                                     ast.While)):
+                    # Recurse into compound bodies with the running flag;
+                    # plain statements are scanned directly.
+                    for field in ("body", "orelse", "finalbody"):
+                        sub = getattr(stmt, field, None)
+                        if sub:
+                            yield from walk_block(sub, fenced)
+                    for handler in getattr(stmt, "handlers", []):
+                        yield from walk_block(handler.body, fenced)
+                    # The test/iter/with-items expressions still get a
+                    # direct scan — `with open(...)` is IO too.
+                    scan_nodes = [getattr(stmt, "test", None),
+                                  getattr(stmt, "iter", None)]
+                    scan_nodes += [
+                        item.context_expr
+                        for item in getattr(stmt, "items", [])
+                    ]
+                    calls = [
+                        c for n in scan_nodes if n is not None
+                        for c in stmt_calls(n)
+                    ]
+                else:
+                    calls = list(stmt_calls(stmt))
+                for call in calls:
+                    kind = _is_telemetry_io_call(call)
+                    if kind and not _suppressed(tree, call.lineno, "GC105"):
+                        yield Violation(
+                            "GC105", tree.rel, call.lineno,
+                            f"{kind} inside the timed step loop with no "
+                            "sync_window fence earlier in its block",
+                            RULES["GC105"].fix_hint,
+                        )
+            if contains_sync(stmt):
+                fenced = True
+
+    for loop in timed_loops(tree.ast):
+        yield from walk_block(loop.body, fenced=False)
 
 
 # ---------------------------------------------------------------------------

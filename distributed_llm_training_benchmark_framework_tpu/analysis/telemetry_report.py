@@ -1,0 +1,354 @@
+#!/usr/bin/env python
+"""Reconstruct a run timeline from its flight-recorder telemetry JSONL.
+
+The write side is ``telemetry.TelemetryRecorder`` (threaded through
+``train/loop.py``); this is the read side — everything an operator needs
+to answer "where did the run's time go, and how was it doing when it
+died" from the JSONL alone:
+
+- **timeline**: the phase intervals (init/compile/warmup/timed/
+  checkpoint/trace/finalize) in run order, with an ASCII gantt bar;
+- **phase attribution**: per-phase totals as a fraction of wall time —
+  the compile-vs-timed split that a single tokens/sec number hides;
+- **trajectories**: loss / window step time / allocator HBM over the
+  run's sync windows (``--plots-out`` renders PNGs; the text report
+  always carries the endpoints and extrema);
+- **anomalies**: NaN-loss and step-time-spike events, with whether they
+  resolved;
+- **profiler join** (``--profile-dir``): lines the JSONL's host-clock
+  step windows up against the Chrome-trace device step lane from
+  ``profile_summary``, so host-side overhead (dispatch, sync RPCs) is
+  separable from device time. ``--run`` picks a run when the profile dir
+  holds several.
+
+Works on aborted/truncated files: a run killed mid-write still renders a
+partial timeline (that is the point of a flight recorder).
+
+    python -m distributed_llm_training_benchmark_framework_tpu.analysis.telemetry_report \
+        --telemetry results/run_results/telemetry_zero2_ws4_seq2048_tierA.jsonl \
+        [--profile-dir /tmp/prof [--run <name>]] [--plots-out plots/]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+from typing import Any, Dict, List, Optional
+
+from ..telemetry import PHASES, read_events
+
+
+# ---------------------------------------------------------------------------
+# Timeline reconstruction
+# ---------------------------------------------------------------------------
+
+
+def build_timeline(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """-> {meta, intervals, phase_times, windows, anomalies, end, wall}.
+
+    ``intervals`` is the ordered list of ``{phase, start_rel, end_rel}``
+    (an interval left open by a crash is closed at the last event's
+    ``rel``); ``phase_times`` sums them per phase; ``end`` is the
+    ``run_end``/``run_aborted`` event when one exists.
+    """
+    meta: Dict[str, Any] = {}
+    intervals: List[Dict[str, Any]] = []
+    windows: List[Dict[str, Any]] = []
+    anomalies: List[Dict[str, Any]] = []
+    end: Optional[Dict[str, Any]] = None
+    open_iv: Optional[Dict[str, Any]] = None
+    last_rel = 0.0
+    for e in events:
+        last_rel = max(last_rel, float(e.get("rel", 0.0)))
+        kind = e.get("event")
+        if kind == "run_meta":
+            meta = e
+        elif kind == "phase_begin":
+            if open_iv is not None:
+                open_iv["end_rel"] = e["rel"]
+            open_iv = {"phase": e["phase"], "start_rel": e["rel"],
+                       "end_rel": None}
+            intervals.append(open_iv)
+        elif kind == "phase_end":
+            if open_iv is not None and open_iv["phase"] == e["phase"]:
+                open_iv["end_rel"] = e["rel"]
+                open_iv = None
+        elif kind == "step_window":
+            windows.append(e)
+        elif kind in ("anomaly", "anomaly_resolved"):
+            anomalies.append(e)
+        elif kind in ("run_end", "run_aborted"):
+            end = e
+    for iv in intervals:
+        if iv["end_rel"] is None:
+            iv["end_rel"] = last_rel  # crash left the phase open
+    phase_times: Dict[str, float] = {}
+    for iv in intervals:
+        phase_times[iv["phase"]] = (
+            phase_times.get(iv["phase"], 0.0)
+            + max(iv["end_rel"] - iv["start_rel"], 0.0)
+        )
+    wall = float(end.get("wall_time_total_sec", last_rel)) if end else last_rel
+    return {
+        "meta": meta, "intervals": intervals, "phase_times": phase_times,
+        "windows": windows, "anomalies": anomalies, "end": end,
+        "wall": wall,
+    }
+
+
+def _gantt_bar(iv: Dict[str, Any], wall: float, width: int = 44) -> str:
+    if wall <= 0:
+        return ""
+    a = int(round(iv["start_rel"] / wall * width))
+    b = max(int(round(iv["end_rel"] / wall * width)), a + 1)
+    return " " * a + "#" * min(b - a, width - a)
+
+
+def format_report(tl: Dict[str, Any]) -> str:
+    out: List[str] = []
+    meta, end, wall = tl["meta"], tl["end"], tl["wall"]
+    arm = meta.get("arm", "?")
+    out.append(f"== Telemetry: {arm} ==")
+    if meta:
+        out.append(
+            "  run: "
+            + " ".join(
+                f"{k}={meta[k]}" for k in (
+                    "strategy", "world_size", "seq_len", "tier",
+                    "model_family", "total_steps",
+                ) if k in meta
+            )
+        )
+    if end is None:
+        out.append("  STATUS: no run_end/run_aborted event — process was "
+                   "killed outright; timeline below ends at the last sync")
+    elif end["event"] == "run_aborted":
+        out.append(f"  STATUS: ABORTED in phase {end.get('phase')!r} at "
+                   f"step {end.get('last_step')} — {end.get('reason')}")
+    else:
+        out.append(f"  STATUS: completed ({end.get('status')}), "
+                   f"last step {end.get('last_step')}")
+
+    out.append("")
+    out.append(f"== Timeline (wall {wall:.2f}s) ==")
+    for iv in tl["intervals"]:
+        dur = iv["end_rel"] - iv["start_rel"]
+        out.append(
+            f"  {iv['phase']:>10}  {iv['start_rel']:8.2f}s ->"
+            f" {iv['end_rel']:8.2f}s ({dur:7.2f}s)  |{_gantt_bar(iv, wall)}"
+        )
+
+    out.append("")
+    out.append("== Phase attribution ==")
+    total = sum(tl["phase_times"].values()) or 1.0
+    for phase in PHASES:
+        if phase not in tl["phase_times"]:
+            continue
+        sec = tl["phase_times"][phase]
+        out.append(f"  {100.0 * sec / wall if wall else 0:5.1f}%  "
+                   f"{sec:9.3f}s  {phase}")
+    covered = 100.0 * total / wall if wall else 0.0
+    out.append(f"  (phases cover {covered:.1f}% of wall time)")
+
+    ws = tl["windows"]
+    if ws:
+        losses = [w["loss"] for w in ws if w.get("loss") is not None]
+        dts = sorted(w["window_mean_step_time_sec"] for w in ws)
+        hbm = [w["peak_hbm_bytes"] for w in ws
+               if w.get("peak_hbm_bytes") is not None]
+        out.append("")
+        out.append(f"== Trajectories ({len(ws)} sync windows, last step "
+                   f"{ws[-1]['step']}) ==")
+        if losses:
+            out.append(f"  loss: first {losses[0]:.4f} -> last "
+                       f"{losses[-1]:.4f} (min {min(losses):.4f})")
+        out.append(
+            f"  window mean step time: median {dts[len(dts) // 2]:.4f}s, "
+            f"max {dts[-1]:.4f}s"
+        )
+        out.append(f"  cumulative tokens/sec: {ws[-1]['tokens_per_sec']:,.0f}"
+                   f" ({ws[-1]['cum_tokens']:,} tokens)")
+        if hbm:
+            out.append(f"  peak HBM (allocator): {max(hbm) / 1e9:.2f} GB")
+
+    if tl["anomalies"]:
+        out.append("")
+        out.append(f"== Anomalies ({len(tl['anomalies'])} events) ==")
+        # A spike's opening event must not read as OPEN when a later
+        # anomaly_resolved event closed it.
+        resolved_opens = {
+            a.get("opened_at_step") for a in tl["anomalies"]
+            if a["event"] == "anomaly_resolved"
+        }
+        for a in tl["anomalies"]:
+            if a["event"] == "anomaly_resolved":
+                tag = "resolved"
+            elif a.get("kind") == "step_time_spike":
+                tag = ("resolved later" if a.get("step") in resolved_opens
+                       else "OPEN")
+            else:
+                tag = "UNRESOLVED"
+            out.append(f"  step {a.get('step')}: {a.get('kind')} [{tag}] "
+                       f"{a.get('detail', '')}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Profiler join
+# ---------------------------------------------------------------------------
+
+
+def join_profile(
+    tl: Dict[str, Any], profile_dir: str, run: Optional[str] = None
+) -> str:
+    """Line the JSONL host-clock windows up against the device step lane."""
+    from . import profile_summary as ps
+
+    trace = ps.find_trace_file(profile_dir, run=run)
+    if trace is None:
+        return f"== Profiler join ==\n  no trace under {profile_dir}"
+    s = ps.summarize(ps.load_events(trace))
+    dev = sorted(s["step_durs_us"])
+    out = ["== Profiler join ==", f"  trace: {trace}"]
+    if not dev:
+        out.append("  trace has no device step lane (no 'Steps' thread)")
+        return "\n".join(out)
+    dev_med = dev[len(dev) // 2] / 1e6
+    # Only the timed windows are comparable: the trace starts after warmup
+    # (train/loop.py starts it at the warmup boundary), so compile/warmup
+    # windows would skew the host-side median.
+    host = sorted(
+        w["window_mean_step_time_sec"] for w in tl["windows"]
+        if w.get("phase") == "timed"
+    ) or sorted(w["window_mean_step_time_sec"] for w in tl["windows"])
+    host_med = host[len(host) // 2]
+    overhead = host_med - dev_med
+    out.append(f"  device steps traced: {len(dev)}, median {dev_med:.4f}s")
+    out.append(f"  telemetry windows:   {len(host)}, median host step "
+               f"{host_med:.4f}s")
+    out.append(
+        f"  host-side overhead:  {overhead:+.4f}s/step "
+        f"({100.0 * overhead / host_med if host_med else 0:.1f}% of the "
+        "host step — dispatch, sync RPCs, python)"
+    )
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Plots (optional)
+# ---------------------------------------------------------------------------
+
+
+def write_plots(tl: Dict[str, Any], out_dir: str) -> List[str]:
+    """Loss / step-time / HBM trajectory PNGs; returns written paths."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    ws = tl["windows"]
+    if not ws:
+        return []
+    os.makedirs(out_dir, exist_ok=True)
+    steps = [w["step"] for w in ws]
+    written: List[str] = []
+    series = [
+        ("loss", [w.get("loss") for w in ws], "loss",
+         "telemetry_loss.png"),
+        ("window mean step time (s)",
+         [w["window_mean_step_time_sec"] for w in ws], "step time",
+         "telemetry_step_time.png"),
+        ("peak HBM (GB)",
+         [None if w.get("peak_hbm_bytes") is None
+          else w["peak_hbm_bytes"] / 1e9 for w in ws], "HBM",
+         "telemetry_hbm.png"),
+    ]
+    for ylabel, ys, title, fname in series:
+        pts = [(s, y) for s, y in zip(steps, ys) if y is not None]
+        if not pts:
+            continue
+        fig, ax = plt.subplots(figsize=(6, 3.2), dpi=150)
+        ax.plot([p[0] for p in pts], [p[1] for p in pts],
+                color="#2a78d6", linewidth=1.2)
+        ax.set_xlabel("step")
+        ax.set_ylabel(ylabel)
+        ax.set_title(f"{tl['meta'].get('arm', '')} {title}", fontsize=9)
+        ax.grid(color="#d9d8d4", linewidth=0.5)
+        for spine in ("top", "right"):
+            ax.spines[spine].set_visible(False)
+        fig.tight_layout()
+        path = os.path.join(out_dir, fname)
+        fig.savefig(path)
+        plt.close(fig)
+        written.append(path)
+    return written
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _discover(results_dir: str) -> List[str]:
+    return sorted(
+        glob.glob(os.path.join(results_dir, "**", "telemetry_*.jsonl"),
+                  recursive=True)
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--telemetry", help="one telemetry_<arm>.jsonl file")
+    src.add_argument("--results-dir",
+                     help="directory searched recursively for "
+                          "telemetry_*.jsonl (reports each)")
+    p.add_argument("--profile-dir", default=None,
+                   help="the harness's --profile-dir: join the JSONL step "
+                        "windows against the Chrome-trace device step lane")
+    p.add_argument("--run", default=None,
+                   help="profile run to join when --profile-dir holds "
+                        "several (see profile_summary --run)")
+    p.add_argument("--plots-out", default=None,
+                   help="directory for loss/step-time/HBM trajectory PNGs")
+    args = p.parse_args(argv)
+
+    paths = [args.telemetry] if args.telemetry else _discover(args.results_dir)
+    if not paths:
+        print(f"ERROR: no telemetry_*.jsonl under {args.results_dir}")
+        return 1
+    rc = 0
+    for i, path in enumerate(paths):
+        if i:
+            print("\n" + "-" * 72 + "\n")
+        try:
+            events = read_events(path)
+        except (OSError, ValueError) as e:
+            print(f"ERROR: cannot read {path}: {e}")
+            rc = 1
+            continue
+        if not events:
+            print(f"ERROR: {path} holds no events")
+            rc = 1
+            continue
+        tl = build_timeline(events)
+        print(f"File: {path}")
+        print(format_report(tl))
+        if args.profile_dir:
+            print()
+            try:
+                print(join_profile(tl, args.profile_dir, run=args.run))
+            except ValueError as e:
+                # Bad/ambiguous --run: report and keep going — the JSONL
+                # reports for the remaining files are still wanted.
+                print(f"ERROR: {e}")
+                rc = 1
+        if args.plots_out:
+            for out in write_plots(tl, args.plots_out):
+                print(f"Wrote {out}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -108,6 +108,18 @@ OFFLOAD_STEP_CV_LIMIT_PCT = 25.0
 # artifacts) skip the check.
 LOSS_DESCENT_MIN_STEPS = 50
 LOSS_DESCENT_DELTA = {"tinygpt": 0.25, "llama": 0.15}
+# Flight-recorder phase-attribution envelope (round 8): the recorder's
+# phases are sequential and disjoint by construction, so the published
+# time_in_* fields must be non-negative and their sum must not exceed the
+# run's wall time (2% relative + 50 ms absolute slack for clock rounding).
+# Rows from before the telemetry round carry no wall_time_total_sec and
+# skip the check.
+PHASE_TIME_FIELDS = (
+    "time_in_init_sec", "time_in_compile_sec", "time_in_warmup_sec",
+    "time_in_timed_sec", "time_in_checkpoint_sec", "time_in_trace_sec",
+)
+PHASE_SUM_REL_TOL = 1.02
+PHASE_SUM_ABS_SLACK_SEC = 0.05
 
 
 def _check(ok: bool, label: str, detail: str, failures: List[str]) -> None:
@@ -272,6 +284,69 @@ def validate_result(r: dict, name: str) -> List[str]:
                 val <= cap, name,
                 f"{label} {val:.2f} GB exceeds {cap:.1f} GB {r['device_kind']} HBM", f,
             )
+
+    # Phase-time attribution envelope (PHASE_TIME_FIELDS above).
+    wall = r.get("wall_time_total_sec", 0.0) or 0.0
+    if wall > 0:
+        phase_sum = 0.0
+        for key in PHASE_TIME_FIELDS:
+            val = r.get(key, 0.0) or 0.0
+            _check(val >= 0, name, f"{key}={val} is negative", f)
+            phase_sum += max(val, 0.0)
+        _check(
+            phase_sum <= wall * PHASE_SUM_REL_TOL + PHASE_SUM_ABS_SLACK_SEC,
+            name,
+            f"phase times sum to {phase_sum:.3f}s > wall_time_total_sec="
+            f"{wall:.3f}s — phases must be disjoint", f,
+        )
+        _check(
+            r.get("n_anomalies", 0) >= 0, name,
+            f"n_anomalies={r.get('n_anomalies')} is negative", f,
+        )
+    return f
+
+
+def validate_telemetry(result_path: str, r: dict, name: str) -> List[str]:
+    """Cross-check a result row against its flight-recorder JSONL.
+
+    The harness writes ``telemetry_<arm>.jsonl`` beside
+    ``result_<arm>.json``; when the sibling exists, a published row must
+    come from a run whose recorder CLOSED cleanly (``run_end`` present —
+    an aborted run's partial row belongs in partial_<arm>.json, not here)
+    with no unresolved anomaly (NaN loss / open step-time spike) events.
+    Log-scraped ``result.json`` copies have no sibling and skip the check.
+    """
+    f: List[str] = []
+    base = os.path.basename(result_path)
+    if not (base.startswith("result_") and base.endswith(".json")):
+        return f
+    arm = base[len("result_"):-len(".json")]
+    tpath = os.path.join(os.path.dirname(result_path), f"telemetry_{arm}.jsonl")
+    if not os.path.exists(tpath):
+        return f
+    try:
+        from ..telemetry import read_events
+    except ImportError:  # run as a standalone script
+        from distributed_llm_training_benchmark_framework_tpu.telemetry import (
+            read_events,
+        )
+    try:
+        events = read_events(tpath)
+    except ValueError as e:
+        return [f"{name}: telemetry JSONL corrupt ({e})"]
+    end = [e for e in events if e.get("event") == "run_end"]
+    _check(
+        len(end) == 1, name,
+        f"result row exists but telemetry has {len(end)} run_end events "
+        "(crashed runs must not publish result rows)", f,
+    )
+    if end:
+        unresolved = end[0].get("n_unresolved_anomalies", 0) or 0
+        _check(
+            unresolved == 0, name,
+            f"telemetry shows {unresolved} unresolved anomaly event(s) "
+            "(NaN loss / open step-time spike) — row rejected", f,
+        )
     return f
 
 
@@ -321,6 +396,7 @@ def collect(results_dir: str, logs_dir: Optional[str]) -> Tuple[List[str], int]:
             failures.append(f"{name}: invalid JSON ({e})")
             continue
         failures.extend(validate_result(r, name))
+        failures.extend(validate_telemetry(path, r, name))
         n += 1
     if logs_dir and os.path.isdir(logs_dir):
         for path in sorted(glob.glob(os.path.join(logs_dir, "*.log"))):

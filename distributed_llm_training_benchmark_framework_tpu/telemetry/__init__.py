@@ -1,0 +1,33 @@
+"""Flight-recorder telemetry: streaming per-step JSONL + heartbeat markers.
+
+The result-marker protocol (utils.metrics) only speaks AFTER a successful
+run — a pod that hangs, OOMs or is preempted at step 173/200 leaves nothing
+in ``kubectl logs`` for ``scripts/collect_results.sh`` to scrape, and a
+single wall-clock number never explains where a run's time went. This
+package is the in-flight channel (docs/OBSERVABILITY.md):
+
+- :class:`TelemetryRecorder` streams structured JSONL events (``run_meta``,
+  ``phase_begin``/``phase_end``, ``step_window``, ``anomaly``,
+  ``run_aborted``, ``run_end``) to ``<results_dir>/telemetry_<arm>.jsonl``
+  with line-buffered writes, so a killed process keeps every event up to
+  its last sync boundary;
+- periodic single-line ``BENCHMARK_HEARTBEAT {json}`` markers on stdout
+  (rank 0, sync boundaries only — never a device sync inside a timed
+  window) make partial progress recoverable from pod logs alone;
+- an excepthook/atexit flusher emits a final ``run_aborted`` event with
+  the phase and last step on any crash the process survives long enough
+  to report.
+
+Consumed by ``analysis.telemetry_report`` (timeline + phase attribution)
+and ``analysis.validate_results`` (anomaly/phase envelopes).
+"""
+
+from .recorder import (  # noqa: F401
+    HEARTBEAT_MARKER,
+    PHASES,
+    SCHEMA_VERSION,
+    TelemetryRecorder,
+    parse_heartbeat_line,
+    read_events,
+    telemetry_filename,
+)

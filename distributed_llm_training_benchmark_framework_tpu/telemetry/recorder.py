@@ -1,0 +1,450 @@
+"""The flight recorder: crash-resilient JSONL events + stdout heartbeats.
+
+Design constraints (see package docstring and docs/OBSERVABILITY.md):
+
+- **Crash resilience over buffering.** The JSONL file is opened
+  line-buffered (``buffering=1``): every event reaches the OS when its
+  line completes, so a SIGKILL'd process keeps everything up to its last
+  sync boundary. The recorder never buffers events in memory.
+- **Zero device syncs.** The recorder is host-side bookkeeping only. It is
+  *called* at sync-window boundaries (where the loop already blocked on
+  the device), and its one device-adjacent read — the allocator HBM
+  high-water mark via ``utils.metrics.peak_hbm_bytes()`` — is a host-side
+  stats query, not a fence. graftcheck rule GC105 (analysis/static/lint.py)
+  pins the call-site discipline in train/loop.py.
+- **Best-effort everywhere.** A full disk or torn-down results dir must
+  degrade telemetry, never fail the benchmark: every write path swallows
+  ``OSError``.
+
+Timestamps: ``ts`` is unix wall time (joinable against profiler traces and
+pod logs), ``rel`` is seconds since recorder creation on the monotonic
+clock (durable arithmetic — wall time can step).
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+#: The stdout scrape marker. scripts/collect_results.sh greps this literal
+#: (and tests/test_telemetry.py pins that the script and this constant
+#: agree), so partial progress survives in pod logs when the final
+#: BENCHMARK_RESULT_JSON markers never print.
+HEARTBEAT_MARKER = "BENCHMARK_HEARTBEAT"
+
+#: Canonical phase names, in their natural run order. ``begin_phase``
+#: accepts only these — a typo'd phase would silently fork the attribution.
+PHASES = (
+    "init", "compile", "warmup", "timed", "trace", "checkpoint", "finalize",
+)
+
+#: A window whose mean step time exceeds SPIKE_FACTOR x the median of the
+#: preceding windows opens a ``step_time_spike`` anomaly; a later window
+#: back under SPIKE_RESOLVE_FACTOR x median resolves it. A spike that
+#: persists for SPIKE_REBASELINE_WINDOWS consecutive windows is a
+#: sustained slowdown, not a stall: it resolves as "rebaselined" and its
+#: level becomes the new median — otherwise the frozen history could
+#: never catch up and a successfully completed (if slower) run would be
+#: rejected by the validator as an open anomaly. NaN losses are never
+#: resolved.
+SPIKE_FACTOR = 3.0
+SPIKE_RESOLVE_FACTOR = 1.5
+SPIKE_MIN_HISTORY = 3
+SPIKE_REBASELINE_WINDOWS = 5
+
+
+def telemetry_filename(arm: str) -> str:
+    return f"telemetry_{arm}.jsonl"
+
+
+def parse_heartbeat_line(line: str) -> Optional[Dict[str, Any]]:
+    """Decode one ``BENCHMARK_HEARTBEAT {json}`` stdout line (or None).
+
+    The single shared parser: the collect script's grep/sed pipeline and
+    the tests both anchor on the same ``MARKER + space + JSON`` shape this
+    function accepts.
+    """
+    line = line.strip()
+    if not line.startswith(HEARTBEAT_MARKER + " "):
+        return None
+    try:
+        payload = json.loads(line[len(HEARTBEAT_MARKER) + 1:])
+    except json.JSONDecodeError:
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Load a telemetry JSONL file, tolerating a torn final line.
+
+    A process killed mid-write legitimately leaves a truncated last line;
+    every complete line before it is still a valid event. A malformed line
+    anywhere *else* raises — that is corruption, not a crash artifact.
+    """
+    events: List[Dict[str, Any]] = []
+    with open(path) as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break  # torn tail from a mid-write kill
+            raise
+    return events
+
+
+class TelemetryRecorder:
+    """Streams run telemetry; tracks phase-time attribution for the result.
+
+    Parameters
+    ----------
+    arm:
+        Run slug — the same stem as the result filename, so
+        ``result_<arm>.json`` and ``telemetry_<arm>.jsonl`` pair up.
+    results_dir:
+        Where the JSONL lands; ``None`` (bench.py in-process arms) keeps
+        the recorder alive for phase accounting but writes no file.
+    is_main:
+        Only rank 0 writes the file and prints heartbeats; other ranks
+        still track phases so their (unpublished) results stay coherent.
+    heartbeat_every_sec:
+        Minimum wall seconds between heartbeat lines. ``0`` prints one per
+        step window (tests); the first window always prints one so even a
+        run killed in its second window left a scrapeable line.
+    tokens_per_step:
+        Global tokens consumed per optimizer step — turns window step
+        times into the cumulative tokens/sec the heartbeat advertises.
+    meta:
+        Run-identity dict echoed into ``run_meta`` and every heartbeat
+        (strategy/world_size/seq_len/tier/... — what collect_results.sh
+        needs to synthesize a partial result row).
+    """
+
+    def __init__(
+        self,
+        arm: str,
+        *,
+        results_dir: Optional[str] = None,
+        is_main: bool = True,
+        enabled: bool = True,
+        heartbeat_every_sec: float = 30.0,
+        tokens_per_step: int = 0,
+        total_steps: int = 0,
+        meta: Optional[Dict[str, Any]] = None,
+    ):
+        self.arm = arm
+        self.is_main = is_main
+        self.enabled = enabled
+        self.heartbeat_every_sec = heartbeat_every_sec
+        self.tokens_per_step = tokens_per_step
+        self.total_steps = total_steps
+        self.meta = dict(meta or {})
+        self._t0 = time.perf_counter()
+        self._phase: Optional[str] = None
+        self._phase_t0 = self._t0
+        self._phase_times: Dict[str, float] = {}
+        self._file = None
+        self._closed = False
+        self._last_step: Optional[int] = None
+        self._last_loss: Optional[float] = None
+        self._last_hb_t: Optional[float] = None
+        self._cum_tokens = 0
+        self._cum_window_sec = 0.0
+        self._window_dts: List[float] = []
+        self._n_anomalies = 0
+        self._nan_anomalies = 0
+        self._open_spike: Optional[int] = None  # step that opened the spike
+        self._spike_dts: List[float] = []  # window dts while a spike is open
+        self.path: Optional[str] = None
+        if enabled and is_main and results_dir:
+            try:
+                os.makedirs(results_dir, exist_ok=True)
+                self.path = os.path.join(results_dir, telemetry_filename(arm))
+                # buffering=1: line-buffered — each event line reaches the
+                # OS as soon as it is written (the crash-resilience core).
+                self._file = open(self.path, "w", buffering=1)
+            except OSError as e:
+                self._file = None
+                print(f"WARNING: telemetry file unavailable: {e}",
+                      file=sys.stderr)
+        self._emit("run_meta", arm=arm, schema_version=SCHEMA_VERSION,
+                   tokens_per_step=tokens_per_step, total_steps=total_steps,
+                   **self.meta)
+        # Backstop flushers for crash paths the loop's try/except never
+        # sees (interpreter teardown, uncaught errors outside the loop).
+        # The loop's own abort() remains the primary path and wins the
+        # _closed race.
+        self._prev_excepthook = sys.excepthook
+        sys.excepthook = self._excepthook
+        atexit.register(self._atexit_flush)
+
+    # ------------------------------------------------------------------
+    # Event plumbing
+    # ------------------------------------------------------------------
+
+    def _rel(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def _emit(self, event: str, **fields: Any) -> None:
+        if self._file is None:
+            return
+        rec = {"event": event, "ts": round(time.time(), 6),
+               "rel": round(self._rel(), 6)}
+        rec.update(fields)
+        try:
+            self._file.write(json.dumps(rec) + "\n")
+        except (OSError, ValueError):
+            pass  # telemetry must never fail the run
+
+    # ------------------------------------------------------------------
+    # Phases
+    # ------------------------------------------------------------------
+
+    @property
+    def phase(self) -> Optional[str]:
+        return self._phase
+
+    def begin_phase(self, name: str) -> None:
+        """End the current phase (if any) and begin ``name``.
+
+        Phases are sequential and non-overlapping by construction, so
+        their durations sum to the covered wall time — the property the
+        telemetry_report attribution and the validate_results envelope
+        both rely on.
+        """
+        if name not in PHASES:
+            raise ValueError(f"unknown telemetry phase {name!r} "
+                             f"(expected one of {PHASES})")
+        now = time.perf_counter()
+        if self._phase is not None:
+            dur = now - self._phase_t0
+            self._phase_times[self._phase] = (
+                self._phase_times.get(self._phase, 0.0) + dur
+            )
+            self._emit("phase_end", phase=self._phase, dur_sec=round(dur, 6))
+        self._phase = name
+        self._phase_t0 = now
+        self._emit("phase_begin", phase=name)
+
+    def phase_times(self) -> Dict[str, float]:
+        """Per-phase accumulated seconds, including the open phase so far."""
+        out = dict(self._phase_times)
+        if self._phase is not None:
+            out[self._phase] = (
+                out.get(self._phase, 0.0)
+                + (time.perf_counter() - self._phase_t0)
+            )
+        return out
+
+    def wall_time_total(self) -> float:
+        return self._rel()
+
+    @property
+    def n_anomalies(self) -> int:
+        return self._n_anomalies
+
+    @property
+    def n_unresolved_anomalies(self) -> int:
+        return self._nan_anomalies + (1 if self._open_spike is not None else 0)
+
+    # ------------------------------------------------------------------
+    # Step windows (called at sync boundaries only)
+    # ------------------------------------------------------------------
+
+    def step_window(
+        self,
+        *,
+        last_step: int,
+        losses: List[float],
+        window_mean_step_time_sec: float,
+    ) -> None:
+        """Record one synced window: per-window stats + anomaly screening.
+
+        Call ONLY after the loop blocked on the window's last loss (the
+        values are real, and the device is already fenced — no extra
+        sync). Samples the allocator HBM high-water mark, updates the
+        cumulative-throughput accounting, screens for NaN losses and
+        step-time spikes, and prints a heartbeat when the interval is due.
+        """
+        n = len(losses)
+        if n == 0:
+            return
+        self._last_step = last_step
+        loss = losses[-1]
+        self._last_loss = loss
+        self._cum_tokens += n * self.tokens_per_step
+        self._cum_window_sec += n * window_mean_step_time_sec
+        tps = (self._cum_tokens / self._cum_window_sec
+               if self._cum_window_sec > 0 else 0.0)
+        hbm = None
+        try:
+            from ..utils.metrics import peak_hbm_bytes
+
+            hbm = peak_hbm_bytes()
+        except Exception:
+            pass
+        self._emit(
+            "step_window",
+            step=last_step,
+            steps_in_window=n,
+            # Non-finite -> null: json.dumps would otherwise write the
+            # non-spec NaN/Infinity tokens and break strict consumers.
+            loss=round(loss, 6) if math.isfinite(loss) else None,
+            window_mean_step_time_sec=round(window_mean_step_time_sec, 6),
+            cum_tokens=self._cum_tokens,
+            tokens_per_sec=round(tps, 3),
+            peak_hbm_bytes=hbm,
+            phase=self._phase,
+        )
+        self._screen_anomalies(last_step, losses, window_mean_step_time_sec)
+        self._heartbeat(last_step, loss, tps, window_mean_step_time_sec)
+
+    def _screen_anomalies(
+        self, last_step: int, losses: List[float], dt: float
+    ) -> None:
+        for l in losses:
+            if l != l or math.isinf(l):
+                self._n_anomalies += 1
+                self._nan_anomalies += 1
+                self._emit("anomaly", kind="nan_loss", step=last_step,
+                           detail="non-finite loss in window")
+                break  # one nan event per window is signal enough
+        history = self._window_dts
+        if len(history) >= SPIKE_MIN_HISTORY:
+            med = sorted(history)[len(history) // 2]
+            if self._open_spike is None and dt > SPIKE_FACTOR * med:
+                self._n_anomalies += 1
+                self._open_spike = last_step
+                self._spike_dts = [dt]
+                self._emit(
+                    "anomaly", kind="step_time_spike", step=last_step,
+                    detail=(f"window mean {dt:.4f}s > {SPIKE_FACTOR}x "
+                            f"median {med:.4f}s"),
+                )
+            elif self._open_spike is not None:
+                if dt <= SPIKE_RESOLVE_FACTOR * med:
+                    self._emit("anomaly_resolved", kind="step_time_spike",
+                               step=last_step,
+                               opened_at_step=self._open_spike)
+                    self._open_spike = None
+                else:
+                    self._spike_dts.append(dt)
+                    if len(self._spike_dts) >= SPIKE_REBASELINE_WINDOWS:
+                        # Sustained slowdown, not a stall: adopt the new
+                        # level as the baseline so the run can still close
+                        # with zero open anomalies (the published step-time
+                        # stats carry the slowdown honestly either way).
+                        self._emit(
+                            "anomaly_resolved", kind="step_time_spike",
+                            step=last_step,
+                            opened_at_step=self._open_spike,
+                            detail=(f"rebaselined after "
+                                    f"{len(self._spike_dts)} windows at "
+                                    "the new level"),
+                        )
+                        self._open_spike = None
+                        # The trailing append below re-adds this window.
+                        self._window_dts = list(self._spike_dts[:-1])
+        # Spike windows stay out of the history so one stall cannot drag
+        # the median up and mask the next stall.
+        if self._open_spike is None:
+            self._window_dts.append(dt)
+
+    def _heartbeat(self, step: int, loss: float, tps: float, dt: float) -> None:
+        if not (self.enabled and self.is_main):
+            return
+        now = time.perf_counter()
+        if (self._last_hb_t is not None
+                and now - self._last_hb_t < self.heartbeat_every_sec):
+            return
+        self._last_hb_t = now
+        payload = {
+            "arm": self.arm,
+            "step": step,
+            "total_steps": self.total_steps,
+            "loss": round(loss, 4) if math.isfinite(loss) else None,
+            "tokens_per_sec": round(tps, 1),
+            "window_mean_step_time_sec": round(dt, 4),
+            "phase": self._phase,
+            "ts": round(time.time(), 3),
+        }
+        payload.update(self.meta)
+        # flush=True: heartbeats must reach a pipe/pod log immediately —
+        # a block-buffered stdout would hold them hostage past a SIGKILL.
+        print(f"{HEARTBEAT_MARKER} {json.dumps(payload)}", flush=True)
+
+    # ------------------------------------------------------------------
+    # Shutdown
+    # ------------------------------------------------------------------
+
+    def _summary_fields(self) -> Dict[str, Any]:
+        return {
+            "last_step": self._last_step,
+            "phase": self._phase,
+            "phase_times": {k: round(v, 6)
+                            for k, v in self.phase_times().items()},
+            "wall_time_total_sec": round(self.wall_time_total(), 6),
+            "n_anomalies": self._n_anomalies,
+            "n_unresolved_anomalies": self.n_unresolved_anomalies,
+        }
+
+    def abort(self, reason: str) -> None:
+        """Emit ``run_aborted`` and release the hooks. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._emit("run_aborted", reason=reason, **self._summary_fields())
+        self._teardown()
+
+    def close(self, status: str = "ok") -> Dict[str, float]:
+        """End the open phase, emit ``run_end``, return the phase times."""
+        if self._closed:
+            return dict(self._phase_times)
+        now = time.perf_counter()
+        if self._phase is not None:
+            dur = now - self._phase_t0
+            self._phase_times[self._phase] = (
+                self._phase_times.get(self._phase, 0.0) + dur
+            )
+            self._emit("phase_end", phase=self._phase, dur_sec=round(dur, 6))
+            self._phase = None
+        self._closed = True
+        self._emit("run_end", status=status, **self._summary_fields())
+        self._teardown()
+        return dict(self._phase_times)
+
+    def _teardown(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        if sys.excepthook is self._excepthook:
+            sys.excepthook = self._prev_excepthook
+        try:
+            atexit.unregister(self._atexit_flush)
+        except Exception:
+            pass
+
+    def _excepthook(self, etype, value, tb) -> None:
+        self.abort(f"exception:{etype.__name__}: {value}")
+        self._prev_excepthook(etype, value, tb)
+
+    def _atexit_flush(self) -> None:
+        # Reached only when neither close() nor abort() ran (e.g. a
+        # sys.exit mid-run): record that the run ended without a verdict.
+        try:
+            self.abort("atexit:process exited before run_end")
+        except Exception:
+            pass

@@ -179,6 +179,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--results-dir", type=str, required=True)
     p.add_argument("--profile-dir", type=str, default=None,
                    help="If set, capture a jax.profiler trace after warmup")
+    # Flight-recorder telemetry (docs/OBSERVABILITY.md): streaming JSONL
+    # events + BENCHMARK_HEARTBEAT stdout markers so a hung/OOM'd/preempted
+    # pod still leaves scrapeable progress in kubectl logs.
+    p.add_argument("--telemetry", choices=["on", "off"], default="on",
+                   help="Flight-recorder telemetry: JSONL event stream "
+                        "(telemetry_<arm>.jsonl beside the result) plus "
+                        "heartbeat stdout markers at sync boundaries")
+    p.add_argument("--heartbeat-sec", type=float, default=30.0,
+                   help="Minimum seconds between BENCHMARK_HEARTBEAT stdout "
+                        "markers (rank 0, sync-window boundaries only; "
+                        "0 = every window)")
     # Checkpoint / resume (orbax; absent entirely in the reference)
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=0,
@@ -319,6 +330,8 @@ def main(argv=None) -> int:
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
             resume=args.resume,
+            telemetry=args.telemetry == "on",
+            heartbeat_sec=args.heartbeat_sec,
         )
     finally:
         dist.cleanup_distributed()

@@ -13,6 +13,14 @@ with TPU-honest timing:
 
 One loop serves every strategy arm — the arm only changes the shardings baked
 into ``state.step_fn``.
+
+Flight-recorder telemetry (round 8, docs/OBSERVABILITY.md): a
+``telemetry.TelemetryRecorder`` rides along for the whole run — JSONL
+events + ``BENCHMARK_HEARTBEAT`` stdout markers at every sync-window
+boundary, phase-time attribution (init/compile/warmup/timed/checkpoint/
+trace/finalize) into the result row, and a ``run_aborted`` event on any
+crash. All recorder call sites sit at sync boundaries (graftcheck rule
+GC105 pins this), so telemetry never adds a device sync to a timed window.
 """
 
 from __future__ import annotations
@@ -28,40 +36,124 @@ from ..data import SyntheticDataset
 from ..models import get_model_config
 from ..parallel import make_mesh, StrategyConfig
 from ..runtime import distributed as dist
+from ..telemetry import TelemetryRecorder
 from ..utils import flops as flops_mod
 from ..utils import metrics as metrics_mod
 from .step import create_train_state
 
 
+def _make_recorder(kwargs: dict) -> TelemetryRecorder:
+    """Build the run's flight recorder from run_benchmark's kwargs.
+
+    Created BEFORE any validation or device work so that even a refused or
+    crashed-at-startup run leaves a ``run_aborted`` trail. Must therefore
+    never raise itself: any surprise in the kwargs degrades to a disabled
+    recorder rather than masking the real error the impl is about to
+    report properly.
+    """
+    try:
+        strategy = kwargs["strategy"]
+        world_size = int(kwargs["world_size"])
+        seq_len = int(kwargs["seq_len"])
+        tier = kwargs["tier"]
+        family = kwargs.get("model_family", "tinygpt")
+        # Shared slug/formula (utils.metrics): the telemetry filename must
+        # pair with result_filename, and heartbeat tokens/sec must match
+        # the published accounting — neither may drift independently.
+        arm = metrics_mod.arm_slug(
+            strategy.name, world_size, seq_len, tier, family
+        )
+        denom = (
+            int(kwargs.get("tensor_parallel", 1))
+            * int(kwargs.get("sequence_parallel", 1))
+            * int(kwargs.get("pipeline_parallel", 1))
+            * int(kwargs.get("expert_parallel", 1))
+        )
+        dp = max(world_size // max(denom, 1), 1)
+        step_tokens = metrics_mod.tokens_per_step(
+            int(kwargs["per_device_batch"]), int(kwargs["grad_accum"]),
+            seq_len, dp, int(kwargs.get("expert_parallel", 1)),
+        )
+        rank = int(kwargs.get("rank", 0))
+        rec = TelemetryRecorder(
+            arm,
+            results_dir=kwargs.get("results_dir"),
+            is_main=dist.is_main_process() and rank == 0,
+            enabled=bool(kwargs.get("telemetry", True)),
+            heartbeat_every_sec=float(kwargs.get("heartbeat_sec", 30.0)),
+            tokens_per_step=step_tokens,
+            total_steps=int(kwargs["steps"]),
+            meta={
+                "strategy": strategy.name,
+                "world_size": world_size,
+                "rank": rank,
+                "seq_len": seq_len,
+                "tier": tier,
+                "model_family": family,
+                "per_device_batch": int(kwargs["per_device_batch"]),
+                "grad_accum": int(kwargs["grad_accum"]),
+                # Composition axes: arms sharing (strategy, ws, seq, tier)
+                # geometry — the zigzag A/B pair, tp vs pp arms — must stay
+                # distinguishable in a salvaged partial row, or the
+                # metrics-dedup collapses two dead arms into one.
+                "attention_impl": kwargs.get("attention_impl", "reference"),
+                "tensor_parallel": int(kwargs.get("tensor_parallel", 1)),
+                "sequence_parallel": int(kwargs.get("sequence_parallel", 1)),
+                "pipeline_parallel": int(kwargs.get("pipeline_parallel", 1)),
+                "pipeline_schedule": kwargs.get("pipeline_schedule", "gpipe"),
+                "expert_parallel": int(kwargs.get("expert_parallel", 1)),
+                "n_experts": int(kwargs.get("n_experts", 0)),
+                "causal": bool(kwargs.get("causal", False)),
+                "ring_zigzag": {None: "auto", True: "on", False: "off"}[
+                    kwargs.get("ring_zigzag")
+                ],
+            },
+        )
+        rec.begin_phase("init")
+        return rec
+    except Exception:
+        return TelemetryRecorder(
+            "unknown", results_dir=None, is_main=False, enabled=False
+        )
+
+
 def run_benchmark(*, prng_impl: str = "rbg", **kwargs) -> metrics_mod.BenchmarkResult:
     """Run one benchmark arm end-to-end and (on rank 0) emit its result.
 
-    Thin wrapper that scopes the dropout-key PRNG choice: 'rbg' (XLA
-    RngBitGenerator) measures ~6% faster end-to-end than the default
-    threefry on v5e — threefry lowers to a long VPU integer chain per
-    bernoulli draw. No cross-framework RNG parity is at stake (the
-    reference uses torch's RNG); 'threefry' remains available for bit-exact
-    reproducibility across jax versions/backends. The process default is
-    restored on exit so embedding callers / later tests keep theirs.
+    Thin wrapper that (a) owns the run's flight recorder — any exception
+    that escapes the arm is recorded as a ``run_aborted`` telemetry event
+    with its phase and last step before propagating — and (b) scopes the
+    dropout-key PRNG choice: 'rbg' (XLA RngBitGenerator) measures ~6%
+    faster end-to-end than the default threefry on v5e — threefry lowers
+    to a long VPU integer chain per bernoulli draw. No cross-framework RNG
+    parity is at stake (the reference uses torch's RNG); 'threefry'
+    remains available for bit-exact reproducibility across jax
+    versions/backends. The process default is restored on exit so
+    embedding callers / later tests keep theirs.
 
     See ``_run_benchmark_impl`` for the full parameter list.
     """
-    if not prng_impl:
-        return _run_benchmark_impl(**kwargs)
-    prev_impl = jax.config.jax_default_prng_impl
+    recorder = _make_recorder(kwargs)
     try:
-        jax.config.update("jax_default_prng_impl", prng_impl)
-    except ValueError:
-        # Older jax spells the threefry enum value 'threefry2x32'; the CLI
-        # name stays 'threefry' (bit-identical generator either way).
-        alias = {"threefry": "threefry2x32"}.get(prng_impl)
-        if alias is None:
-            raise
-        jax.config.update("jax_default_prng_impl", alias)
-    try:
-        return _run_benchmark_impl(**kwargs)
-    finally:
-        jax.config.update("jax_default_prng_impl", prev_impl)
+        if not prng_impl:
+            return _run_benchmark_impl(recorder=recorder, **kwargs)
+        prev_impl = jax.config.jax_default_prng_impl
+        try:
+            jax.config.update("jax_default_prng_impl", prng_impl)
+        except ValueError:
+            # Older jax spells the threefry enum value 'threefry2x32'; the
+            # CLI name stays 'threefry' (bit-identical generator either way).
+            alias = {"threefry": "threefry2x32"}.get(prng_impl)
+            if alias is None:
+                raise
+            jax.config.update("jax_default_prng_impl", alias)
+        try:
+            return _run_benchmark_impl(recorder=recorder, **kwargs)
+        finally:
+            jax.config.update("jax_default_prng_impl", prev_impl)
+    except BaseException as e:
+        recorder.abort(f"exception:{type(e).__name__}: {e}")
+        raise
 
 
 def _run_benchmark_impl(
@@ -103,8 +195,23 @@ def _run_benchmark_impl(
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 0,
     resume: bool = False,
+    telemetry: bool = True,
+    heartbeat_sec: float = 30.0,
+    recorder: Optional[TelemetryRecorder] = None,
 ) -> metrics_mod.BenchmarkResult:
-    """Benchmark body (see run_benchmark)."""
+    """Benchmark body (see run_benchmark).
+
+    ``telemetry``/``heartbeat_sec`` configure the flight recorder (already
+    consumed by ``_make_recorder`` when entering via run_benchmark);
+    ``recorder`` is injected by the wrapper so the crash guard outlives
+    this frame.
+    """
+    if recorder is None:
+        # Direct-impl callers (tests) still get phase accounting.
+        recorder = TelemetryRecorder(
+            "direct", results_dir=None, is_main=False, enabled=False
+        )
+        recorder.begin_phase("init")
     is_main = dist.is_main_process() and rank == 0
     devices = jax.devices()
     if world_size > len(devices):
@@ -418,30 +525,47 @@ def _run_benchmark_impl(
     pending: list = []  # (step, loss_handle) since last sync
 
     def sync_window(t_start):
-        """Block on the window's last loss; distribute wall time evenly."""
+        """Block on the window's last loss; distribute wall time evenly.
+
+        Also the telemetry boundary: with the device already fenced, the
+        recorder logs the window (step/loss/mean time/HBM sample) and may
+        print a heartbeat — the only sanctioned place for telemetry IO in
+        the loop (graftcheck GC105).
+        """
         if not pending:
             return
         jax.block_until_ready(pending[-1][1])
         dt = (time.perf_counter() - t_start) / len(pending)
+        window_losses = []
         for s, l in pending:
+            lf = float(l)
+            window_losses.append(lf)
             if s >= warmup_steps:
                 step_times.append(dt)
-                losses.append(float(l))
+                losses.append(lf)
             if is_main and s % log_every == 0:
-                print(f"[Step {s:04d}] Loss: {float(l):.4f}, Time: {dt:.3f}s")
+                print(f"[Step {s:04d}] Loss: {lf:.4f}, Time: {dt:.3f}s")
+        recorder.step_window(
+            last_step=pending[-1][0], losses=window_losses,
+            window_mean_step_time_sec=dt,
+        )
         pending.clear()
 
+    recorder.begin_phase("compile")
     t_window = time.perf_counter()
     for step in range(start_step, steps):
         if profile_dir and step == warmup_steps and is_main and not trace_started:
             sync_window(t_window)
+            recorder.begin_phase("trace")
             jax.profiler.start_trace(profile_dir)
             trace_started = True
             t_window = time.perf_counter()
-        if step == warmup_steps and sync_every > 1:
-            # Warmup excluded from averages; fence so its tail doesn't leak
-            # into the first timed window.
-            sync_window(t_window)
+        if step == warmup_steps and step > start_step:
+            if sync_every > 1:
+                # Warmup excluded from averages; fence so its tail doesn't
+                # leak into the first timed window.
+                sync_window(t_window)
+            recorder.begin_phase("timed")
             t_window = time.perf_counter()
         if serial_state is not None and step == offload_dpu_start_step:
             # Serial -> delayed transition at a sync boundary: extend the
@@ -472,8 +596,27 @@ def _run_benchmark_impl(
             t_window = time.perf_counter()
         params, opt_state, loss = active_state.step_fn(params, opt_state, table, step)
         pending.append((step, loss))
+        if step == start_step and step < warmup_steps:
+            # Fence the first dispatched step on its own: its wall time is
+            # dominated by the XLA compile, and attributing it to the
+            # 'compile' phase (begun just before the loop) is what lets
+            # telemetry_report answer "where did startup go". Only when the
+            # first step is UNTIMED warmup: a timed first step (warmup 0,
+            # or resume past warmup) keeps the pre-telemetry window shape —
+            # a solo fence there would concentrate the whole compile into
+            # step 0's published time and distort the p95/max/cv columns.
+            sync_window(t_window)
+            recorder.begin_phase("warmup")
+            t_window = time.perf_counter()
         if len(pending) >= sync_every or step == steps - 1:
             sync_window(t_window)
+            if recorder.phase in ("compile", "trace"):
+                # Timed-first-step runs (warmup 0 / resume past warmup)
+                # reach here still in 'compile' (or 'trace', when a warmup-0
+                # run also profiles): the first window carries compile + its
+                # steps inseparably (exactly as it is timed), and everything
+                # after is honest 'timed'.
+                recorder.begin_phase("timed")
             t_window = time.perf_counter()
         # Checkpointing happens at a sync boundary, outside the next timed
         # window, so benchmark step times stay honest. The serial phase of
@@ -486,20 +629,32 @@ def _run_benchmark_impl(
             and (serial_state is None or step >= offload_dpu_start_step)
         ):
             sync_window(t_window)
+            recorder.begin_phase("checkpoint")
             ckpt.save(step, params, opt_state)
             if is_main:
                 print(f"Checkpoint saved at step {step}")
+            recorder.begin_phase("timed" if step >= warmup_steps else "warmup")
             t_window = time.perf_counter()
 
     sync_window(t_window)
     if ckpt is not None:
+        recorder.begin_phase("checkpoint")
         # Final save only if this run actually executed steps — a resume that
         # had nothing left to do must not relabel later-step state.
         if start_step < steps:
             ckpt.save(steps - 1, params, opt_state, force=True)
         ckpt.close()
     if trace_started:
+        # stop_trace serializes the Chrome trace to disk — seconds for a
+        # large run; bracket it so that cost attributes to 'trace', not to
+        # whatever phase the loop left open.
+        recorder.begin_phase("trace")
         jax.profiler.stop_trace()
+    # Everything after the loop — barrier, memory accounting, diagnostics,
+    # result computation/emission — is 'finalize': without a phase of its
+    # own it would silently pad whatever phase happened to be open, and
+    # the phase sum would drift from the measured wall time.
+    recorder.begin_phase("finalize")
 
     dist.barrier()
 
@@ -598,7 +753,11 @@ def _run_benchmark_impl(
         model_family=model_family,
         resumed=start_step > 0,
         prior_peak_bytes=prior_peak_bytes,
+        wall_time_total_sec=recorder.wall_time_total(),
+        phase_times=recorder.phase_times(),
+        n_anomalies=recorder.n_anomalies,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)
+    recorder.close("ok")
     return result

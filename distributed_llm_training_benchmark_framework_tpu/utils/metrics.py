@@ -37,6 +37,30 @@ MARKER_START = "BENCHMARK_RESULT_JSON_START"
 MARKER_END = "BENCHMARK_RESULT_JSON_END"
 
 
+def arm_slug(
+    strategy: str, world_size: int, seq_len: int, tier: str,
+    model_family: str = "tinygpt",
+) -> str:
+    """The run's artifact stem: ``result_<slug>.json`` pairs with
+    ``telemetry_<slug>.jsonl`` (the flight recorder's file), and
+    validate_results cross-checks them purely by this slug — so there is
+    exactly one place that builds it. Non-default families suffix the
+    name; the tinygpt form stays bit-compatible with the reference scheme
+    (train_harness.py:443-446)."""
+    fam = "" if model_family == "tinygpt" else f"_{model_family}"
+    return f"{strategy}_ws{world_size}_seq{seq_len}_tier{tier}{fam}"
+
+
+def tokens_per_step(
+    per_device_batch: int, grad_accum: int, seq_len: int, dp: int,
+    expert_parallel: int = 1,
+) -> int:
+    """Global tokens one optimizer step consumes (see compute_result's
+    honest-accounting note) — shared with the telemetry recorder so
+    heartbeat tokens/sec can never drift from the published formula."""
+    return per_device_batch * grad_accum * seq_len * dp * expert_parallel
+
+
 def peak_hbm_bytes() -> Optional[int]:
     """Peak device-memory bytes in use, or None when the backend can't say.
 
@@ -277,18 +301,33 @@ class BenchmarkResult:
     # loss starts wherever the checkpoint left off, so the from-scratch
     # descent envelope does not apply.
     resumed: bool = False
+    # --- flight-recorder phase attribution (telemetry.TelemetryRecorder,
+    # round 8) — where the run's wall time actually went. Measured from
+    # recorder start to result computation; the run's telemetry JSONL
+    # (telemetry_<arm>.jsonl, run_end event) carries the final total
+    # including emission itself. The phase fields are disjoint by
+    # construction, so their sum never exceeds wall_time_total_sec
+    # (validate_results enforces it). All 0.0 for pre-round-8 artifacts.
+    wall_time_total_sec: float = 0.0
+    time_in_init_sec: float = 0.0
+    time_in_compile_sec: float = 0.0
+    time_in_warmup_sec: float = 0.0
+    time_in_timed_sec: float = 0.0
+    time_in_checkpoint_sec: float = 0.0
+    time_in_trace_sec: float = 0.0
+    # Count of anomaly events (NaN loss, step-time spikes) the recorder
+    # screened over the run's sync windows; validate_results rejects rows
+    # whose telemetry shows them unresolved.
+    n_anomalies: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
 
     def result_filename(self) -> str:
-        # Non-default families suffix the name; the tinygpt filename stays
-        # bit-compatible with the reference scheme (train_harness.py:443-446).
-        fam = "" if self.model_family == "tinygpt" else f"_{self.model_family}"
-        return (
-            f"result_{self.strategy}_ws{self.world_size}"
-            f"_seq{self.seq_len}_tier{self.tier}{fam}.json"
-        )
+        return "result_" + arm_slug(
+            self.strategy, self.world_size, self.seq_len, self.tier,
+            self.model_family,
+        ) + ".json"
 
 
 def compute_result(
@@ -330,6 +369,9 @@ def compute_result(
     model_family: str = "tinygpt",
     resumed: bool = False,
     prior_peak_bytes: Optional[int] = None,
+    wall_time_total_sec: float = 0.0,
+    phase_times: Optional[Dict[str, float]] = None,
+    n_anomalies: int = 0,
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
@@ -352,8 +394,10 @@ def compute_result(
     dp = world_size // (
         tensor_parallel * sequence_parallel * pipeline_parallel * expert_parallel
     )
-    tokens_per_step = per_device_batch * grad_accum * seq_len * dp * expert_parallel
-    tps = tokens_per_step / mean_step if mean_step > 0 else 0.0
+    step_tokens = tokens_per_step(
+        per_device_batch, grad_accum, seq_len, dp, expert_parallel
+    )
+    tps = step_tokens / mean_step if mean_step > 0 else 0.0
     bytes_per_step = per_device_batch * grad_accum * seq_len * 4
     h2d = (bytes_per_step / mean_step) / 1e9 if mean_step > 0 else 0.0
     peak_gb, peak_method = measure_peak_hbm(
@@ -377,6 +421,7 @@ def compute_result(
         cv = 100.0 * var**0.5 / mean_step if mean_step > 0 else 0.0
     else:
         p50 = p95 = t_max = cv = 0.0
+    pt = phase_times or {}
     return BenchmarkResult(
         strategy=strategy,
         world_size=world_size,
@@ -429,6 +474,14 @@ def compute_result(
         loss_last_window=loss_last,
         loss_window_steps=lw,
         resumed=resumed,
+        wall_time_total_sec=round(wall_time_total_sec, 4),
+        time_in_init_sec=round(pt.get("init", 0.0), 4),
+        time_in_compile_sec=round(pt.get("compile", 0.0), 4),
+        time_in_warmup_sec=round(pt.get("warmup", 0.0), 4),
+        time_in_timed_sec=round(pt.get("timed", 0.0), 4),
+        time_in_checkpoint_sec=round(pt.get("checkpoint", 0.0), 4),
+        time_in_trace_sec=round(pt.get("trace", 0.0), 4),
+        n_anomalies=n_anomalies,
     )
 
 
@@ -467,6 +520,16 @@ def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True)
     )
     print(f"  H2D GB/s/chip:    {result.h2d_gbps_per_gpu:.3f}")
     print(f"  Mean loss:        {result.mean_loss:.4f}")
+    if result.wall_time_total_sec > 0:
+        print(
+            f"  Wall time:        {result.wall_time_total_sec:.1f}s"
+            f"  (compile {result.time_in_compile_sec:.1f}s,"
+            f" warmup {result.time_in_warmup_sec:.1f}s,"
+            f" timed {result.time_in_timed_sec:.1f}s,"
+            f" checkpoint {result.time_in_checkpoint_sec:.1f}s)"
+        )
+    if result.n_anomalies > 0:
+        print(f"  ANOMALIES:        {result.n_anomalies} (see telemetry JSONL)")
     print("=" * 80 + "\n")
 
     os.makedirs(results_dir, exist_ok=True)

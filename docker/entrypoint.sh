@@ -82,6 +82,11 @@ export CHECKPOINT_DIR="${CHECKPOINT_DIR:-}"
 export CHECKPOINT_EVERY="${CHECKPOINT_EVERY:-}"
 export RESUME="${RESUME:-0}"
 export DEBUG="${DEBUG:-0}"
+# Flight-recorder telemetry (docs/OBSERVABILITY.md): on by default — the
+# heartbeat markers are what scripts/collect_results.sh scrapes into a
+# partial_<arm>.json when a pod dies before the final result marker.
+export TELEMETRY="${TELEMETRY:-}"
+export HEARTBEAT_SEC="${HEARTBEAT_SEC:-}"
 
 echo "Config:"
 for v in STRATEGY WORLD_SIZE NUM_PROCESSES RANK MASTER_ADDR MASTER_PORT \
@@ -153,6 +158,10 @@ if [ -n "${CHECKPOINT_DIR}" ]; then
   ARGS="${ARGS} --checkpoint-dir ${CHECKPOINT_DIR}"; fi
 if [ -n "${CHECKPOINT_EVERY}" ]; then
   ARGS="${ARGS} --checkpoint-every ${CHECKPOINT_EVERY}"; fi
+if [ -n "${TELEMETRY}" ]; then
+  ARGS="${ARGS} --telemetry ${TELEMETRY}"; fi
+if [ -n "${HEARTBEAT_SEC}" ]; then
+  ARGS="${ARGS} --heartbeat-sec ${HEARTBEAT_SEC}"; fi
 # Boolean knobs: 1 = pass the flag.
 if [ "${SKIP_MEMORY_CHECK}" = "1" ]; then
   ARGS="${ARGS} --skip-memory-check"; fi

@@ -4,7 +4,11 @@
 # Contract parity with the reference collector (scripts/collect_results.sh
 # there): results are extracted from logs between BENCHMARK_RESULT_JSON_START
 # and BENCHMARK_RESULT_JSON_END markers, because pod/emptyDir filesystems die
-# with the pod. Two modes:
+# with the pod. A run that died before the final markers (hang/OOM/preempt)
+# is salvaged from its BENCHMARK_HEARTBEAT lines (the flight-recorder
+# telemetry channel, docs/OBSERVABILITY.md): the LAST heartbeat becomes
+# partial_<arm>.json with the run's last step/loss/tokens-per-sec, so failed
+# arms appear in the report as partial rows instead of vanishing. Two modes:
 #
 #   collect_results.sh --log <file> <outdir>        # local-run log file
 #   collect_results.sh --k8s <namespace> <job> <outdir>   # kubectl logs
@@ -23,13 +27,48 @@ extract() {
     rm -f "$out/result.json"
     return 1
   fi
+  # A successful scrape supersedes any partial salvage from an earlier
+  # failed attempt at the same arm — a stale partial_<arm>.json would
+  # resurface in metrics.csv as a phantom "died mid-run" row.
+  rm -f "$out"/partial_*.json
   echo "Extracted $out/result.json"
+}
+
+# Salvage partial progress from heartbeat markers when the final result
+# marker never printed. The grep pattern and the JSON-after-marker shape are
+# the telemetry contract (telemetry/recorder.py HEARTBEAT_MARKER; pinned by
+# tests/test_telemetry.py so recorder and scraper cannot drift apart).
+extract_partial() {
+  local log="$1" out="$2"
+  local hb n
+  hb=$(grep -a '^BENCHMARK_HEARTBEAT {' "$log" | tail -1 \
+       | sed 's/^BENCHMARK_HEARTBEAT //') || true
+  [ -z "$hb" ] && return 1
+  n=$(grep -ac '^BENCHMARK_HEARTBEAT {' "$log") || n=0
+  mkdir -p "$out"
+  # The payload travels by env var: the heredoc already owns stdin.
+  HB_JSON="$hb" N_HEARTBEATS="$n" python - "$out" <<'EOF'
+import json, os, sys
+d = json.loads(os.environ["HB_JSON"])
+d["partial"] = True
+d["n_heartbeats"] = int(os.environ.get("N_HEARTBEATS", "0"))
+arm = d.get("arm", "unknown")
+path = os.path.join(sys.argv[1], f"partial_{arm}.json")
+with open(path, "w") as f:
+    json.dump(d, f, indent=2)
+print(f"Extracted PARTIAL {path} (run died before the final result marker)")
+EOF
 }
 
 case "${1:-}" in
   --log)
     [ $# -eq 3 ] || usage
-    extract "$2" "$3"
+    if ! extract "$2" "$3"; then
+      extract_partial "$2" "$3" || {
+        echo "ERROR: no heartbeat lines in $2 either — nothing to salvage" >&2
+        exit 1
+      }
+    fi
     ;;
   --k8s)
     [ $# -eq 4 ] || usage
@@ -61,8 +100,21 @@ case "${1:-}" in
       N=$((N + 1))
     done
     if [ "$EXTRACTED" -eq 0 ]; then
-      echo "ERROR: no result JSON in any of $N pod log(s) for $JOB" >&2
-      exit 1
+      # No pod reached the final markers: salvage the furthest heartbeat
+      # (rank 0 prints them, but scan every log — rendezvous failures can
+      # leave rank 0 silent while another pod logged the crash context).
+      for POD in $PODS; do
+        if extract_partial "$OUT/$POD.log" "$OUT/${JOB}_results"; then
+          EXTRACTED=2
+          break
+        fi
+      done
+      if [ "$EXTRACTED" -eq 0 ]; then
+        echo "ERROR: no result JSON (and no heartbeat lines) in any of $N" \
+             "pod log(s) for $JOB" >&2
+        exit 1
+      fi
+      echo "WARNING: $JOB yielded only a partial result (heartbeat salvage)" >&2
     fi
     ;;
   *) usage ;;

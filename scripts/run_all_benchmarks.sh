@@ -160,6 +160,11 @@ run_local() {
   else
     echo "FAILED — last 20 log lines:"
     tail -20 "$log" || true
+    # Salvage partial progress from the flight-recorder heartbeats so the
+    # failed arm appears in the report as a partial row instead of
+    # vanishing (collect_results.sh falls back to partial_<arm>.json).
+    scripts/collect_results.sh --log "$log" "$RESULTS_DIR/${name}_results" \
+      || true
     FAIL=$((FAIL+1))
   fi
 }
@@ -190,6 +195,11 @@ run_k8s() {
   else
     echo "FAILED — last 100 log lines:"
     kubectl -n "$NAMESPACE" logs -l "job-name=$job" --tail=100 || true
+    # Still collect: saves every pod's log for diagnosis and salvages a
+    # partial_<arm>.json from the heartbeat markers when any pod got far
+    # enough to print one (the pod filesystem dies with the pod — the
+    # scrape is the only copy).
+    scripts/collect_results.sh --k8s "$NAMESPACE" "$job" "$RESULTS_DIR" || true
     FAIL=$((FAIL+1))
   fi
   kubectl -n "$NAMESPACE" delete job "$job" --ignore-not-found

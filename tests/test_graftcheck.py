@@ -114,7 +114,9 @@ def test_lint_is_clean_on_head():
 
 
 def test_rule_catalog_is_complete():
-    assert set(lint.RULES) == {"GC101", "GC102", "GC103", "GC104", "GC201"}
+    assert set(lint.RULES) == {
+        "GC101", "GC102", "GC103", "GC104", "GC105", "GC201",
+    }
     for rule in lint.RULES.values():
         assert rule.fix_hint and rule.description
 
@@ -196,6 +198,72 @@ def test_gc103_fires_on_unknown_axis(tmp_path):
     assert len(violations) == 1
     assert "'modle'" in violations[0].message
     assert "data" in violations[0].message  # known axes listed in the finding
+
+
+def test_gc105_fires_on_unfenced_io_in_timed_loop(tmp_path):
+    """Telemetry/file-IO/print in the timed loop must sit AFTER a
+    sync_window fence in its block; the sanctioned sync_window helper
+    itself (a nested def) is exempt."""
+    root = _scratch_root(tmp_path, "train/loop.py", """\
+        def run(steps, step_fn, state, recorder, f):
+            pending = []
+
+            def sync_window():
+                recorder.step_window(last_step=0, losses=[],
+                                     window_mean_step_time_sec=0.1)
+
+            for step in range(steps):
+                state, loss = step_fn(state, step)
+                print("unfenced progress")
+                recorder.begin_phase("timed")
+                f.write("unfenced io")
+                with open("/tmp/marker", "w"):
+                    pass
+                if step % 10 == 0:
+                    sync_window()
+                    print("fenced: after the sync in this block")
+                    recorder.step_window(last_step=step, losses=[],
+                                         window_mean_step_time_sec=0.1)
+            return state
+    """)
+    violations = lint.run_lint(root=root, rules=("GC105",))
+    assert [v.line for v in violations] == [10, 11, 12, 13]
+    assert {v.rule_id for v in violations} == {"GC105"}
+    assert "sync_window" in violations[0].fix_hint
+    messages = [v.message for v in violations]
+    assert any("print()" in m for m in messages)
+    assert any("recorder.begin_phase()" in m for m in messages)
+    assert any(".write()" in m for m in messages)
+
+
+def test_gc105_conditional_fence_and_suppression(tmp_path):
+    """A sibling `if` containing sync_window fences the rest of the block
+    (the loop's warmup-boundary idiom), and the pragma is honored."""
+    root = _scratch_root(tmp_path, "train/loop.py", """\
+        def run(steps, step_fn, state, recorder, sync_every):
+            def sync_window():
+                pass
+
+            for step in range(steps):
+                state, loss = step_fn(state, step)
+                if sync_every > 1:
+                    sync_window()
+                recorder.begin_phase("timed")
+                print("also fenced")
+                open("/tmp/log")  # still fenced
+
+            for step in range(steps):
+                state, loss = step_fn(state, step)
+                print("deliberate")  # graftcheck: disable=GC105
+            return state
+    """)
+    assert lint.run_lint(root=root, rules=("GC105",)) == []
+
+
+def test_gc105_clean_on_head():
+    """train/loop.py's real recorder call sites all sit at sync
+    boundaries — the discipline the rule exists to keep."""
+    assert lint.run_lint(rules=("GC105",)) == []
 
 
 def test_gc104_fires_on_time_time(tmp_path):

@@ -88,6 +88,53 @@ def test_zero_arm_requires_no_explicit_config(smoke_run):
     assert proc.returncode == 0
 
 
+def test_telemetry_jsonl_phases_bracket(smoke_run):
+    """The flight recorder rode along: telemetry_<arm>.jsonl sits beside
+    the result, every phase_begin has its phase_end, the canonical phases
+    appear in run order, and the phase durations sum to the measured wall
+    time (the 5% acceptance envelope — by construction the phases are
+    contiguous, so real coverage is ~100%)."""
+    import json as _json
+
+    _, results = smoke_run
+    path = results / "telemetry_zero2_ws4_seq64_tierS.jsonl"
+    assert path.exists(), list(results.iterdir())
+    events = [_json.loads(l) for l in path.read_text().splitlines() if l]
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "run_meta" and kinds[-1] == "run_end"
+    begun = [e["phase"] for e in events if e["event"] == "phase_begin"]
+    ended = [e["phase"] for e in events if e["event"] == "phase_end"]
+    assert begun == ended  # every phase bracketed, in order
+    assert begun[:4] == ["init", "compile", "warmup", "timed"]
+    assert begun[-1] == "finalize"
+    end = events[-1]
+    assert end["status"] == "ok" and end["last_step"] == 7
+    psum = sum(end["phase_times"].values())
+    assert abs(psum - end["wall_time_total_sec"]) < 0.05 * end[
+        "wall_time_total_sec"
+    ]
+    # Result row carries the attribution additively.
+    r = _json.loads((results / "result_zero2_ws4_seq64_tierS.json").read_text())
+    assert r["wall_time_total_sec"] > 0
+    assert r["time_in_compile_sec"] > 0
+    assert r["n_anomalies"] == 0
+
+
+def test_heartbeat_markers_on_stdout(smoke_run):
+    """Rank 0 printed scrapeable BENCHMARK_HEARTBEAT lines (at least the
+    first window's), each a parseable single-line JSON with run identity."""
+    import json as _json
+
+    proc, _ = smoke_run
+    lines = [l for l in proc.stdout.splitlines()
+             if l.startswith("BENCHMARK_HEARTBEAT ")]
+    assert lines, proc.stdout[-2000:]
+    hb = _json.loads(lines[0].split(" ", 1)[1])
+    assert hb["arm"] == "zero2_ws4_seq64_tierS"
+    assert hb["strategy"] == "zero2" and hb["world_size"] == 4
+    assert "step" in hb and "tokens_per_sec" in hb
+
+
 def test_harness_interleaved_cli(tmp_path):
     """CLI -> interleaved schedule e2e: --pipeline-schedule interleaved with
     --virtual-stages reaches the executor (schedule fields land in the

@@ -92,6 +92,9 @@ if "logs" in argv:
         result.update(expert_parallel=2, n_experts=8)
     elif comp == "llama-tp2":
         result.update(tensor_parallel=2, model_family="llama", causal=True)
+    elif comp == "llama-flagship":
+        result.update(model_family="llama", causal=True, per_device_batch=2,
+                      grad_accum=2, attention_impl="flash")
     print("boot log line")
     print("BENCHMARK_RESULT_JSON_START")
     print(json.dumps(result, indent=2))
@@ -210,6 +213,7 @@ COMP_JOBS = {
     "tpu-bench-zero2-ws4-moe-ep2",
     "tpu-bench-zero2-ws4-moe8-ep2",
     "tpu-bench-fsdp-ws4-llama-tp2",
+    "tpu-bench-zero2-ws4-llama-flagship",
 }
 
 
@@ -242,10 +246,10 @@ def roster_run(tmp_path_factory):
     return proc, tmp, results
 
 
-def test_roster_exits_zero_with_eleven_arms(roster_run):
+def test_roster_exits_zero_with_twelve_arms(roster_run):
     proc, _, _ = roster_run
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
-    assert "11 passed, 0 failed" in proc.stdout
+    assert "12 passed, 0 failed" in proc.stdout
 
 
 def test_roster_job_names_and_manifest_env(roster_run):
@@ -275,6 +279,14 @@ def test_roster_job_names_and_manifest_env(roster_run):
     assert 'name: RING_ZIGZAG\n              value: "auto"' in zz
     nozz = (tmp / "manifest_tpu-bench-zero2-ws4-sp2-ring-causal-nozz.yaml").read_text()
     assert 'name: RING_ZIGZAG\n              value: "off"' in nozz
+    # The llama-flagship arm carries its swept geometry (bench.py flagship
+    # sub-object config, docs/PERFORMANCE.md §16) into the pod env.
+    fl = (tmp / "manifest_tpu-bench-zero2-ws4-llama-flagship.yaml").read_text()
+    assert 'name: MODEL_FAMILY\n              value: "llama"' in fl
+    assert 'name: PER_DEVICE_BATCH\n              value: "2"' in fl
+    assert 'name: GRAD_ACCUM\n              value: "2"' in fl
+    assert 'name: LAYER_LOOP\n              value: "unrolled"' in fl
+    assert 'name: ATTENTION\n              value: "flash"' in fl
     moe = (tmp / "manifest_tpu-bench-zero2-ws4-moe-ep2.yaml").read_text()
     assert 'name: OFFLOAD_OPT_STATE\n              value: "0"' in moe
     assert 'name: NUM_EXPERTS\n              value: "4"' in moe
@@ -292,9 +304,10 @@ def test_roster_rows_survive_dedup(roster_run):
     import pandas as pd
 
     df = pd.read_csv(results / "summary" / "metrics.csv")
-    # 11 composition runs, all (strategy, ws)-colliding pairs kept distinct
+    # 12 composition runs, all (strategy, ws)-colliding pairs kept distinct
     # by the composition axes in the identity key (sp2-ring vs
     # sp2-ring-causal collide on everything except the causal column; the
     # zigzag A/B pair only on ring_zigzag; the two MoE arms only on
-    # n_experts; the llama arm on model_family + tensor_parallel).
-    assert len(df) == 11, df
+    # n_experts; the llama arms on model_family + tensor_parallel and on
+    # the flagship's batch geometry + attention impl).
+    assert len(df) == 12, df
