@@ -146,7 +146,7 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
     }
 
 
-def main():
+def build_parser():
     p = argparse.ArgumentParser()
     p.add_argument("--strategy", default="zero2")
     p.add_argument("--tier", default="A")
@@ -186,7 +186,21 @@ def main():
     # runs before any arm launches; see run_preflight for scope.
     p.add_argument("--skip-preflight", action="store_true",
                    help="skip the graftcheck static preflight gate")
-    args = p.parse_args()
+    # Run-registry integration (regress/, docs/REGRESSION.md): 'auto'
+    # ingests this invocation's rows and prints a one-line verdict vs the
+    # last known good WHEN a registry already exists (seeded at
+    # results/registry, or pointed at by $REGRESS_REGISTRY); 'on' creates
+    # the registry if needed; 'off' skips. Verdict goes to stderr — the
+    # stdout single-JSON-line contract is untouched.
+    p.add_argument("--regress", default="auto", choices=["auto", "on", "off"])
+    p.add_argument("--registry", default=None,
+                   help="registry root (default: $REGRESS_REGISTRY or "
+                        "results/registry)")
+    return p
+
+
+def main():
+    args = build_parser().parse_args()
 
     if not args.skip_preflight:
         run_preflight()
@@ -241,6 +255,74 @@ def main():
         }
 
     print(json.dumps(payload))
+    record_in_registry(args, payload)
+
+
+def registry_rows(args, payload):
+    """(source, contract_row, run_params) per registry record to ingest.
+
+    Run parameters ride into each record: the registry's config_key
+    includes them, so a --steps 12 smoke invocation forms its own
+    lineage instead of polluting the default 100-step headline's noise
+    floor — and a DEFAULT invocation's key matches the committed legacy
+    seed's (store.ingest_legacy backfills the same flagless defaults;
+    pinned by tests/test_regress.py).
+    """
+    run_params = {
+        "strategy": args.strategy, "tier": args.tier,
+        "seq_len": args.seq_len, "steps": args.steps,
+        "warmup_steps": args.warmup_steps,
+        "sync_every": args.sync_every,
+    }
+    rows = [("bench.py", {k: v for k, v in payload.items()
+                          if k != "flagship"},
+             dict(run_params, model_family=args.model_family,
+                  per_device_batch=args.per_device_batch,
+                  grad_accum=args.grad_accum,
+                  layer_loop=args.layer_loop))]
+    if "flagship" in payload:
+        # The flagship sub-object already carries its swept geometry
+        # provenance keys; only the shared run length is added.
+        rows.append(("bench.py:flagship", payload["flagship"], run_params))
+    return rows
+
+
+def record_in_registry(args, payload) -> None:
+    """Ingest this invocation's rows and report a verdict vs last-good.
+
+    Best-effort by design (telemetry posture): a broken registry must
+    degrade the accounting, never fail the benchmark that just measured.
+    Everything prints to stderr; exceptions are reported, not raised.
+    """
+    if args.regress == "off":
+        return
+    try:
+        from distributed_llm_training_benchmark_framework_tpu.regress import (
+            compare as regress_compare,
+            store as regress_store,
+        )
+
+        reg = regress_store.Registry(args.registry)
+        if args.regress == "auto" and not reg.exists():
+            print(
+                f"regress: no registry at {reg.root} — skipping ingest "
+                "(seed one with `regress ingest --legacy`, or pass "
+                "--regress on)", file=sys.stderr,
+            )
+            return
+        for source, row, extra in registry_rows(args, payload):
+            rec = regress_store.record_from_bench_row(
+                row, source=source, extra_result=extra,
+            )
+            rec, created = reg.ingest(rec)
+            tag = "" if created else " (already ingested)"
+            print(f"regress: recorded {rec['arm']} {rec['record_id']}"
+                  f"{tag} -> {reg.root}", file=sys.stderr)
+            print(regress_compare.verdict_line_for_bench(reg, rec),
+                  file=sys.stderr)
+    except Exception as e:  # never fail a measured run on bookkeeping
+        print(f"WARNING: regress registry unavailable: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

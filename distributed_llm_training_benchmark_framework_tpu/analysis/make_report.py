@@ -99,8 +99,57 @@ def fmt_table(df: pd.DataFrame, cols: List[str]) -> str:
     return "\n".join([header, sep] + rows)
 
 
+def trend_section(registry_root: str, limit: int = 5) -> List[str]:
+    """Per-arm run-over-run history from the regress registry.
+
+    One table per arm: the newest ``limit`` records with delta vs the
+    previous ok run. Partial (heartbeat-salvaged) records appear flagged
+    but never anchor deltas or the best-run marker — the same exclusion
+    the summary superlatives apply to partial rows.
+    """
+    from ..regress import compare as regress_compare
+    from ..regress import store as regress_store
+
+    # SchemaDrift can surface at open (newer registry meta) OR while
+    # loading any single record ingested by a newer writer (mixed-version
+    # fleet) — either way the report must degrade to an "unavailable"
+    # note, never die with a traceback and take BENCHMARK_REPORT.md down
+    # with it.
+    try:
+        reg = regress_store.Registry(registry_root)
+        if not reg.exists():
+            return []
+        out = ["## Per-arm trend (registry)", "",
+               f"Run-over-run history from "
+               f"`{os.path.basename(registry_root)}` "
+               f"(newest {limit}; delta vs previous ok run; `regress trend "
+               "<arm>` for the full history and a PNG).", ""]
+        for arm in reg.arms():
+            rows = regress_compare.trend_rows(reg, arm, limit=limit)
+            if not rows:
+                continue
+            out.append(f"### {arm}")
+            out.append("")
+            out.append("| record | value | metric | delta vs prev | status |")
+            out.append("|---|---|---|---|---|")
+            for r in rows:
+                val = f"{r['value']:,.2f}" if r["value"] is not None else "-"
+                delta = (f"{r['delta_pct_vs_prev']:+.2f}%"
+                         if r["delta_pct_vs_prev"] is not None else "-")
+                status = r["status"] + (" (best)" if r["best"] else "")
+                out.append(
+                    f"| `{r['record_id']}` | {val} "
+                    f"| {r['metric_name'] or '-'} | {delta} | {status} |"
+                )
+            out.append("")
+        return out
+    except regress_store.SchemaDrift as e:
+        return ["## Per-arm trend (registry)", "", f"_unavailable: {e}_", ""]
+
+
 def build_report(
-    df: pd.DataFrame, plots_dir: str = "../plots", plots_root: str = ""
+    df: pd.DataFrame, plots_dir: str = "../plots", plots_root: str = "",
+    registry_root: str = "",
 ) -> str:
     df = df.copy()
     cols = [
@@ -210,6 +259,9 @@ def build_report(
         )
     out.append("")
 
+    if registry_root:
+        out += trend_section(registry_root)
+
     out += ["## Plots", ""]
     for name, caption in [
         ("tokens_per_sec_vs_gpu.png", "Throughput vs chip count"),
@@ -236,13 +288,17 @@ def main(argv=None) -> int:
     p.add_argument("--csv", required=True, help="path to metrics.csv")
     p.add_argument("--out", required=True, help="output directory")
     p.add_argument("--plots-dir", default="../plots")
+    p.add_argument("--registry", default=None,
+                   help="regress registry root: adds the per-arm trend "
+                        "section (run-over-run history)")
     args = p.parse_args(argv)
     df = pd.read_csv(args.csv)
     os.makedirs(args.out, exist_ok=True)
     path = os.path.join(args.out, "BENCHMARK_REPORT.md")
     plots_root = os.path.normpath(os.path.join(args.out, args.plots_dir))
     with open(path, "w") as f:
-        f.write(build_report(df, args.plots_dir, plots_root=plots_root))
+        f.write(build_report(df, args.plots_dir, plots_root=plots_root,
+                             registry_root=args.registry or ""))
     print(f"Wrote {path}")
     return 0
 

@@ -19,7 +19,11 @@ died" from the JSONL alone:
   step windows up against the Chrome-trace device step lane from
   ``profile_summary``, so host-side overhead (dispatch, sync RPCs) is
   separable from device time. ``--run`` picks a run when the profile dir
-  holds several.
+  holds several;
+- **cross-run comparison** (``--compare A.jsonl B.jsonl``): per-phase
+  wall-time deltas plus timed-window step-time/throughput distributions
+  with significance verdicts, delegated to the ``regress.stats`` engine
+  (the registry gate's statistics — one implementation, two views).
 
 Works on aborted/truncated files: a run killed mid-write still renders a
 partial timeline (that is the point of a flight recorder).
@@ -236,6 +240,77 @@ def join_profile(
 
 
 # ---------------------------------------------------------------------------
+# Cross-run comparison (--compare A.jsonl B.jsonl)
+# ---------------------------------------------------------------------------
+
+
+def format_compare(rep: Dict[str, Any]) -> str:
+    """Render the regress.stats.compare_telemetry report (regression
+    triage across two runs — the ROADMAP telemetry follow-up (d)).
+
+    The statistics are the regress engine's — the same seeded bootstrap
+    / rank test / verdict rule the registry gate applies — so this view
+    and `regress compare` can never disagree about the same two runs.
+    """
+    out: List[str] = ["== Telemetry compare =="]
+    for tag in ("a", "b"):
+        side = rep[tag]
+        out.append(
+            f"  {tag.upper()}: arm={side['arm']} wall={side['wall']:.2f}s "
+            f"timed_windows={side['n_timed_windows']}"
+        )
+    out.append("")
+    out.append("== Phase delta (seconds) ==")
+    out.append(f"  {'phase':>10}  {'A':>9}  {'B':>9}  {'delta':>9}  {'%':>8}")
+    for row in rep["phases"]:
+        a = f"{row['a_sec']:.3f}" if row["a_sec"] is not None else "-"
+        b = f"{row['b_sec']:.3f}" if row["b_sec"] is not None else "-"
+        d = (f"{row['delta_sec']:+.3f}" if row["delta_sec"] is not None
+             else "-")
+        pct = (f"{row['delta_pct']:+.1f}%" if row["delta_pct"] is not None
+               else "-")
+        out.append(f"  {row['phase']:>10}  {a:>9}  {b:>9}  {d:>9}  {pct:>8}")
+    out.append("")
+    out.append("== Timed-window distributions (regress.stats) ==")
+    for c in rep["comparisons"]:
+        out.append(
+            f"  {c.metric}: A mean {c.base_mean:,.4f} -> B mean "
+            f"{c.cand_mean:,.4f} (n={c.n_base}/{c.n_cand})"
+        )
+        out.append(f"    {c.summary()}")
+    verdicts = [c.verdict for c in rep["comparisons"]]
+    overall = verdicts[0] if verdicts else "insufficient-data"
+    out.append(f"  VERDICT: {overall}")
+    return "\n".join(out)
+
+
+def run_compare(path_a: str, path_b: str) -> int:
+    """Exit codes match `regress compare` (the same stats engine, so the
+    two views must also agree as gates): 0 clean/neutral, 1 the primary
+    comparison verdicts a regression, 2 unreadable input."""
+    from ..regress import stats as regress_stats
+
+    events = []
+    for path in (path_a, path_b):
+        try:
+            evs = read_events(path)
+        except (OSError, ValueError) as e:
+            print(f"ERROR: cannot read {path}: {e}")
+            return 2
+        if not evs:
+            print(f"ERROR: {path} holds no events")
+            return 2
+        events.append(evs)
+    rep = regress_stats.compare_telemetry(events[0], events[1])
+    print(f"A: {path_a}")
+    print(f"B: {path_b}")
+    print(format_compare(rep))
+    comps = rep["comparisons"]
+    primary = comps[0].verdict if comps else None
+    return 1 if primary == regress_stats.VERDICT_REGRESSION else 0
+
+
+# ---------------------------------------------------------------------------
 # Plots (optional)
 # ---------------------------------------------------------------------------
 
@@ -304,6 +379,10 @@ def main(argv=None) -> int:
     src.add_argument("--results-dir",
                      help="directory searched recursively for "
                           "telemetry_*.jsonl (reports each)")
+    src.add_argument("--compare", nargs=2, metavar=("A", "B"),
+                     help="two telemetry JSONL files: per-phase + "
+                          "per-window delta tables with significance "
+                          "verdicts (regress.stats engine)")
     p.add_argument("--profile-dir", default=None,
                    help="the harness's --profile-dir: join the JSONL step "
                         "windows against the Chrome-trace device step lane")
@@ -313,6 +392,9 @@ def main(argv=None) -> int:
     p.add_argument("--plots-out", default=None,
                    help="directory for loss/step-time/HBM trajectory PNGs")
     args = p.parse_args(argv)
+
+    if args.compare:
+        return run_compare(args.compare[0], args.compare[1])
 
     paths = [args.telemetry] if args.telemetry else _discover(args.results_dir)
     if not paths:

@@ -1,0 +1,409 @@
+"""regress CLI: ingest / compare / trend / gate over the run registry.
+
+    python -m distributed_llm_training_benchmark_framework_tpu.regress \
+        ingest --results-dir results [--registry results/registry]
+    ... regress ingest --legacy            # seed from BENCH_r*/MULTICHIP_r*
+    ... regress compare <id-or-sel> <id-or-sel> [--arm ARM]
+    ... regress trend <arm> [--png trend.png] [--limit N]
+    ... regress gate --baseline last-good --candidate latest [--arm ARM|--all]
+
+Exit codes mirror graftcheck (the other standing gate): 0 clean, 1 a
+significant regression (gate) or a failed comparison the caller asked to
+enforce, 2 operational error (schema drift, unknown record, bad usage).
+
+Selectors accepted wherever a record is named: a record-id prefix,
+``latest`` (newest record for --arm), or ``last-good`` (newest ok,
+non-partial record for --arm). The gate's contract — pinned by the
+frozen-fixture proof in tests/test_regress.py — is that a regression
+line names the arm, the metric, the delta and the confidence interval:
+
+    regress gate: REGRESSION arm=<arm> metric=tokens_per_sec \
+        delta=-10.12% CI95=[-10.80%, -9.45%] p=... baseline=<id> candidate=<id>
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import stats, store
+
+
+# ---------------------------------------------------------------------------
+# Record resolution
+# ---------------------------------------------------------------------------
+
+
+def resolve_selector(
+    reg: store.Registry, selector: str, arm: Optional[str],
+) -> Dict[str, Any]:
+    if selector == "latest":
+        if not arm:
+            raise KeyError("selector 'latest' needs --arm")
+        rec = reg.latest(arm)
+        if rec is None:
+            raise KeyError(f"no records for arm {arm!r}")
+        return rec
+    if selector == "last-good":
+        if not arm:
+            raise KeyError("selector 'last-good' needs --arm")
+        rec = reg.baseline(arm)
+        if rec is None:
+            raise KeyError(f"no ok (non-partial) records for arm {arm!r}")
+        return rec
+    return reg.resolve(selector)
+
+
+# ---------------------------------------------------------------------------
+# Comparison / gate core
+# ---------------------------------------------------------------------------
+
+
+def compare_pair(
+    reg: store.Registry,
+    base_rec: Dict[str, Any],
+    cand_rec: Dict[str, Any],
+    *,
+    min_effect_pct: float = stats.DEFAULT_MIN_EFFECT_PCT,
+    alpha: float = stats.DEFAULT_ALPHA,
+) -> Dict[str, Any]:
+    """Compare two records with registry history as the noise floor."""
+    arm = cand_rec.get("arm", base_rec.get("arm", "?"))
+    metric_name = (cand_rec.get("metric") or {}).get("name", "tokens_per_sec")
+    history = reg.history_values(
+        arm, metric_name=metric_name,
+        exclude_record_id=cand_rec.get("record_id"),
+        match_config_of=cand_rec,
+    )
+    comparisons = stats.compare_records(
+        base_rec, cand_rec, min_effect_pct=min_effect_pct, alpha=alpha,
+        history=history,
+    )
+    return {
+        "arm": arm,
+        "baseline": base_rec.get("record_id"),
+        "candidate": cand_rec.get("record_id"),
+        "comparisons": comparisons,
+        "verdict": comparisons[0].verdict if comparisons else
+        stats.VERDICT_INSUFFICIENT,
+    }
+
+
+def format_comparison(rep: Dict[str, Any]) -> str:
+    lines = [
+        f"== regress compare: {rep['arm']} ==",
+        f"  baseline : {rep['baseline']}",
+        f"  candidate: {rep['candidate']}",
+    ]
+    for c in rep["comparisons"]:
+        lines.append(
+            f"  {c.metric}: base {c.base_mean:,.2f} -> cand "
+            f"{c.cand_mean:,.2f} ({c.mode}, n={c.n_base}/{c.n_cand})"
+        )
+        lines.append(f"    {c.summary()}")
+    lines.append(f"  VERDICT: {rep['verdict']}")
+    return "\n".join(lines)
+
+
+def gate_arm(
+    reg: store.Registry, arm: str, *,
+    baseline_sel: str = "last-good", candidate_sel: str = "latest",
+    min_effect_pct: float = stats.DEFAULT_MIN_EFFECT_PCT,
+    alpha: float = stats.DEFAULT_ALPHA,
+) -> Tuple[str, str]:
+    """Gate one arm; returns (verdict, human line).
+
+    A partial candidate never verdicts (its last-window rate is not a
+    run mean); a missing baseline is insufficient-data, not a failure —
+    the first-ever suite run on a fresh registry must pass the gate.
+    """
+    cand = resolve_selector(reg, candidate_sel, arm)
+    if cand.get("status") != "ok":
+        return (stats.VERDICT_INSUFFICIENT,
+                f"regress gate: SKIP arm={arm} candidate "
+                f"{cand.get('record_id')} has status="
+                f"{cand.get('status')!r} (partial runs never verdict)")
+    if baseline_sel == "last-good":
+        base = reg.baseline(
+            arm, exclude_record_id=cand.get("record_id"),
+            match_config_of=cand,
+        )
+    else:
+        base = resolve_selector(reg, baseline_sel, arm)
+        if base.get("status") != "ok":
+            return (stats.VERDICT_INSUFFICIENT,
+                    f"regress gate: SKIP arm={arm} baseline "
+                    f"{base.get('record_id')} has status="
+                    f"{base.get('status')!r} (partial runs are never "
+                    "baselines)")
+    if base is None:
+        return (stats.VERDICT_INSUFFICIENT,
+                f"regress gate: SKIP arm={arm} — no prior ok record with "
+                "matching config (first run on this arm)")
+    rep = compare_pair(
+        reg, base, cand, min_effect_pct=min_effect_pct, alpha=alpha,
+    )
+    c = rep["comparisons"][0]
+    line = (
+        f"regress gate: {rep['verdict'].upper()} arm={arm} {c.summary()} "
+        f"baseline={rep['baseline']} candidate={rep['candidate']}"
+    )
+    return rep["verdict"], line
+
+
+def verdict_line_for_bench(
+    reg: store.Registry, record: Dict[str, Any],
+) -> str:
+    """bench.py's one-line verdict vs last known good (stderr channel)."""
+    arm = record["arm"]
+    base = reg.baseline(
+        arm, exclude_record_id=record.get("record_id"),
+        match_config_of=record,
+    )
+    if base is None:
+        return (f"regress: arm={arm} first record with this configuration "
+                "— no baseline to compare against")
+    rep = compare_pair(reg, base, record)
+    c = rep["comparisons"][0]
+    return (
+        f"regress: {rep['verdict'].upper()} vs last-good arm={arm} "
+        f"{c.summary()} (baseline={base.get('record_id')} from "
+        f"{base.get('source', '?')})"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Trend
+# ---------------------------------------------------------------------------
+
+
+def trend_rows(
+    reg: store.Registry, arm: str, limit: int = 0,
+) -> List[Dict[str, Any]]:
+    """History table rows for one arm, oldest first.
+
+    Delta is vs the previous OK row (partials are carried in the table —
+    flagged — but neither anchor deltas nor count as best; the same
+    exclusion parse_metrics applies to scaling efficiency).
+    """
+    recs = reg.records(arm)
+    if limit:
+        recs = recs[-limit:]
+    rows: List[Dict[str, Any]] = []
+    prev_ok: Optional[float] = None
+    best = max(
+        (r.get("metric", {}).get("value") for r in recs
+         if r.get("status") == "ok"
+         and r.get("metric", {}).get("value") is not None),
+        default=None,
+    )
+    for rec in recs:
+        val = rec.get("metric", {}).get("value")
+        delta = None
+        if rec.get("status") == "ok" and val is not None and prev_ok:
+            delta = 100.0 * (val - prev_ok) / prev_ok
+        rows.append({
+            "record_id": rec.get("record_id"),
+            "status": rec.get("status"),
+            "source": rec.get("source", ""),
+            "metric_name": rec.get("metric", {}).get("name"),
+            "value": val,
+            "delta_pct_vs_prev": delta,
+            "best": (rec.get("status") == "ok" and val is not None
+                     and best is not None and val == best),
+        })
+        if rec.get("status") == "ok" and val is not None:
+            prev_ok = val
+    return rows
+
+
+def format_trend(arm: str, rows: List[Dict[str, Any]]) -> str:
+    out = [f"== regress trend: {arm} ({len(rows)} records) =="]
+    for r in rows:
+        val = f"{r['value']:,.2f}" if r["value"] is not None else "-"
+        delta = (f"{r['delta_pct_vs_prev']:+.2f}%"
+                 if r["delta_pct_vs_prev"] is not None else "      ")
+        flags = ("PARTIAL" if r["status"] != "ok"
+                 else ("BEST" if r["best"] else ""))
+        out.append(
+            f"  {r['record_id']}  {val:>14} {r['metric_name'] or '':<24}"
+            f" {delta:>8}  {flags:<7} {r['source']}"
+        )
+    return "\n".join(out)
+
+
+def write_trend_png(arm: str, rows: List[Dict[str, Any]], path: str) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    xs = list(range(len(rows)))
+    ys = [r["value"] for r in rows]
+    ok = [i for i in xs if rows[i]["status"] == "ok" and ys[i] is not None]
+    bad = [i for i in xs if rows[i]["status"] != "ok" and ys[i] is not None]
+    fig, ax = plt.subplots(figsize=(6, 3.2), dpi=150)
+    if ok:
+        ax.plot([xs[i] for i in ok], [ys[i] for i in ok],
+                marker="o", color="#2a78d6", linewidth=1.2, label="ok")
+    if bad:
+        ax.scatter([xs[i] for i in bad], [ys[i] for i in bad],
+                   marker="x", color="#c0392b", label="partial")
+        ax.legend(fontsize=7)
+    ax.set_xlabel("ingest order")
+    ax.set_ylabel(rows[0]["metric_name"] if rows else "value")
+    ax.set_title(f"{arm} trend", fontsize=9)
+    ax.grid(color="#d9d8d4", linewidth=0.5)
+    for spine in ("top", "right"):
+        ax.spines[spine].set_visible(False)
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fig.savefig(path)
+    plt.close(fig)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_llm_training_benchmark_framework_tpu"
+             ".regress",
+        description="benchreg: run registry + statistical regression gate "
+                    "(docs/REGRESSION.md)",
+    )
+    p.add_argument("--registry", default=None,
+                   help="registry root (default: $REGRESS_REGISTRY or "
+                        "results/registry)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pi = sub.add_parser("ingest", help="ingest run artifacts into the registry")
+    pi.add_argument("--results-dir", default=None,
+                    help="suite results tree (result_<arm>.json + "
+                         "partial_<arm>.json + telemetry JSONL siblings)")
+    pi.add_argument("--legacy", action="store_true",
+                    help="seed from the repo-root BENCH_r*.json / "
+                         "MULTICHIP_r*.json snapshots")
+    pi.add_argument("--root", default=None,
+                    help="snapshot directory for --legacy (default: repo root)")
+
+    pc = sub.add_parser("compare", help="compare two records")
+    pc.add_argument("a", help="baseline: record-id prefix | latest | last-good")
+    pc.add_argument("b", help="candidate: record-id prefix | latest | last-good")
+    pc.add_argument("--arm", default=None,
+                    help="required when a selector is latest/last-good")
+    pc.add_argument("--min-effect-pct", type=float,
+                    default=stats.DEFAULT_MIN_EFFECT_PCT)
+    pc.add_argument("--alpha", type=float, default=stats.DEFAULT_ALPHA)
+
+    pt = sub.add_parser("trend", help="history table (+PNG) for one arm")
+    pt.add_argument("arm")
+    pt.add_argument("--png", default=None, help="write a trend PNG here")
+    pt.add_argument("--limit", type=int, default=0,
+                    help="only the newest N records (0 = all)")
+
+    pg = sub.add_parser("gate", help="fail (exit 1) on significant regression")
+    pg.add_argument("--baseline", default="last-good",
+                    help="baseline selector (default last-good)")
+    pg.add_argument("--candidate", default="latest",
+                    help="candidate selector (default latest)")
+    pg.add_argument("--arm", default=None, help="gate one arm")
+    pg.add_argument("--all", action="store_true",
+                    help="gate every arm's latest vs its last-good")
+    pg.add_argument("--min-effect-pct", type=float,
+                    default=stats.DEFAULT_MIN_EFFECT_PCT)
+    pg.add_argument("--alpha", type=float, default=stats.DEFAULT_ALPHA)
+
+    sub.add_parser("list", help="list arms and record counts")
+
+    args = p.parse_args(argv)
+
+    try:
+        reg = store.Registry(args.registry)
+    except store.SchemaDrift as e:
+        print(f"regress: {e}", file=sys.stderr)
+        return 2
+
+    try:
+        if args.cmd == "ingest":
+            if not args.legacy and not args.results_dir:
+                p.error("ingest needs --results-dir and/or --legacy")
+            ingested: List[Tuple[Dict[str, Any], bool]] = []
+            if args.legacy:
+                ingested += store.ingest_legacy(reg, args.root)
+            if args.results_dir:
+                ingested += store.ingest_results_dir(reg, args.results_dir)
+            created = sum(1 for _, c in ingested if c)
+            print(f"regress ingest: {len(ingested)} artifact(s) scanned, "
+                  f"{created} new record(s) -> {reg.root}")
+            for rec, c in ingested:
+                if c:
+                    print(f"  + {rec['arm']} {rec['record_id']} "
+                          f"[{rec['status']}] from {rec.get('source', '')}")
+            return 0
+
+        if args.cmd == "compare":
+            a = resolve_selector(reg, args.a, args.arm)
+            b = resolve_selector(reg, args.b, args.arm)
+            rep = compare_pair(
+                reg, a, b, min_effect_pct=args.min_effect_pct,
+                alpha=args.alpha,
+            )
+            print(format_comparison(rep))
+            return 1 if rep["verdict"] == stats.VERDICT_REGRESSION else 0
+
+        if args.cmd == "trend":
+            rows = trend_rows(reg, args.arm, limit=args.limit)
+            if not rows:
+                print(f"regress trend: no records for arm {args.arm!r} "
+                      f"in {reg.root}", file=sys.stderr)
+                return 2
+            print(format_trend(args.arm, rows))
+            if args.png:
+                print(f"Wrote {write_trend_png(args.arm, rows, args.png)}")
+            return 0
+
+        if args.cmd == "gate":
+            if args.all:
+                arms = [a for a in reg.arms()]
+            elif args.arm:
+                arms = [args.arm]
+            else:
+                p.error("gate needs --arm or --all")
+            n_regressions = 0
+            for arm in arms:
+                verdict, line = gate_arm(
+                    reg, arm, baseline_sel=args.baseline,
+                    candidate_sel=args.candidate,
+                    min_effect_pct=args.min_effect_pct, alpha=args.alpha,
+                )
+                print(line)
+                if verdict == stats.VERDICT_REGRESSION:
+                    n_regressions += 1
+            print(f"regress gate: {len(arms)} arm(s) checked, "
+                  f"{n_regressions} regression(s)")
+            return 1 if n_regressions else 0
+
+        if args.cmd == "list":
+            for arm in reg.arms():
+                lines = [l for l in reg.index_lines() if l["arm"] == arm]
+                n_ok = sum(1 for l in lines if l["status"] == "ok")
+                print(f"{arm}: {len(lines)} record(s) ({n_ok} ok)")
+            return 0
+    except store.SchemaDrift as e:
+        print(f"regress: {e}", file=sys.stderr)
+        return 2
+    except KeyError as e:
+        print(f"regress: {e.args[0] if e.args else e}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,490 @@
+"""The shared statistics engine behind every regression verdict.
+
+One module owns the math so the gate (``regress.compare``), the telemetry
+comparison (``analysis.telemetry_report --compare``) and the trend tables
+cannot drift apart — the ISSUE-4 acceptance contract. Everything here is
+deterministic: the bootstrap is seeded, the permutation test is seeded,
+and there is no wall-clock or platform dependence, so the frozen-fixture
+gate proof (tests/test_regress.py) byte-reproduces everywhere.
+
+Why distributions, not single numbers: a benchmark arm's published
+tokens/sec is a mean over ~100 steps, and two means 3% apart say nothing
+without the spread behind them. The flight recorder (PR 3) already
+persists per-window step times at every sync boundary; those windows are
+the per-run sample this module feeds into
+
+- a **seeded bootstrap** for confidence intervals on the relative delta
+  of means (percentile method — no normality assumption);
+- a **Mann-Whitney U** rank test (normal approximation with tie
+  correction) for windows-sized samples, falling back to a **seeded
+  permutation test** of the mean difference when either side is tiny;
+- a **noise floor** estimated from repeated same-arm runs in the
+  registry (the legacy BENCH_r02..r05 snapshots alone pin bench-headline
+  run-to-run noise at well under 1%), so the minimum effect a verdict
+  requires is max(configured threshold, observed noise) — raw deltas
+  never verdict on their own.
+
+Verdicts are the closed set {regression, improvement, neutral,
+insufficient-data}: a significant-but-tiny delta is *neutral* (below the
+minimum effect), a large-but-unsupported delta is *neutral* (failed the
+significance test), and too few samples is *insufficient-data*, never a
+silent pass pretending to be evidence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+#: Fewer timed windows than this on either side -> insufficient-data in
+#: window mode (a 100-step, sync-every-10 run yields ~9; smoke runs less).
+MIN_WINDOWS = 4
+#: Two-sided significance level for the rank/permutation test.
+DEFAULT_ALPHA = 0.05
+#: Minimum relative effect (%) a verdict requires even when the noise
+#: floor is lower — sub-2% deltas on these arms are weather, not climate.
+DEFAULT_MIN_EFFECT_PCT = 2.0
+#: Noise floor assumed when the registry holds too little same-arm
+#: history to estimate one.
+DEFAULT_NOISE_FLOOR_PCT = 1.0
+#: Scalar mode has no within-run distribution, so its verdict leans
+#: entirely on the history-derived noise floor — below this many
+#: same-config history runs the floor is a guess, and a guess must not
+#: mint a regression: the comparison reports insufficient-data instead.
+#: (Window mode needs no history and verdicts from run #2.)
+MIN_SCALAR_HISTORY = 3
+#: Bootstrap resamples and the fixed seed (determinism is a feature: the
+#: gate must give the same verdict on the same records every time).
+BOOTSTRAP_N = 2000
+BOOTSTRAP_SEED = 20260803
+PERMUTATION_N = 4000
+#: Below this per-side size the normal approximation is shaky; use the
+#: permutation test instead.
+SMALL_SAMPLE_N = 5
+
+VERDICT_REGRESSION = "regression"
+VERDICT_IMPROVEMENT = "improvement"
+VERDICT_NEUTRAL = "neutral"
+VERDICT_INSUFFICIENT = "insufficient-data"
+
+
+# ---------------------------------------------------------------------------
+# Telemetry extraction
+# ---------------------------------------------------------------------------
+
+
+def timed_windows(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The comparable sample: ``step_window`` events from the timed phase.
+
+    Compile/warmup windows are excluded (their times measure XLA, not the
+    step); a run that never reached the timed phase yields [] and the
+    comparison degrades to scalar mode rather than comparing warmup noise.
+    """
+    out = []
+    for e in events:
+        if e.get("event") != "step_window" or e.get("phase") != "timed":
+            continue
+        dt = e.get("window_mean_step_time_sec")
+        if dt is None or dt <= 0:
+            continue
+        out.append({
+            "step": e.get("step"),
+            "steps_in_window": e.get("steps_in_window", 1),
+            "dt": float(dt),
+            "loss": e.get("loss"),
+        })
+    return out
+
+
+def window_step_times(record: Dict[str, Any]) -> List[float]:
+    return [w["dt"] for w in record.get("windows", []) if w.get("dt")]
+
+
+def window_tokens_per_sec(record: Dict[str, Any]) -> List[float]:
+    """Per-window throughput: tokens_per_step / window mean step time."""
+    tps = record.get("tokens_per_step", 0) or 0
+    if tps <= 0:
+        return []
+    return [tps / w["dt"] for w in record.get("windows", []) if w.get("dt")]
+
+
+# ---------------------------------------------------------------------------
+# Core statistics (all seeded / closed-form)
+# ---------------------------------------------------------------------------
+
+
+def _phi(z: float) -> float:
+    """Standard normal CDF via erf (no scipy dependency)."""
+    return 0.5 * (1.0 + math.erf(z / math.sqrt(2.0)))
+
+
+def mann_whitney_p(a: Sequence[float], b: Sequence[float]) -> float:
+    """Two-sided Mann-Whitney U p-value (normal approx, tie-corrected).
+
+    Identical samples (zero rank variance) return p=1.0 — indistinguishable
+    by construction.
+    """
+    n1, n2 = len(a), len(b)
+    if n1 == 0 or n2 == 0:
+        return 1.0
+    pooled = sorted([(v, 0) for v in a] + [(v, 1) for v in b])
+    # Average ranks over ties.
+    ranks = [0.0] * len(pooled)
+    i = 0
+    while i < len(pooled):
+        j = i
+        while j < len(pooled) and pooled[j][0] == pooled[i][0]:
+            j += 1
+        avg_rank = (i + j + 1) / 2.0  # ranks are 1-based
+        for k in range(i, j):
+            ranks[k] = avg_rank
+        i = j
+    r1 = sum(r for r, (_, grp) in zip(ranks, pooled) if grp == 0)
+    u1 = r1 - n1 * (n1 + 1) / 2.0
+    mu = n1 * n2 / 2.0
+    n = n1 + n2
+    # Tie-corrected variance.
+    tie_term = 0.0
+    i = 0
+    while i < len(pooled):
+        j = i
+        while j < len(pooled) and pooled[j][0] == pooled[i][0]:
+            j += 1
+        t = j - i
+        tie_term += t**3 - t
+        i = j
+    var = n1 * n2 / 12.0 * ((n + 1) - tie_term / (n * (n - 1)))
+    if var <= 0:
+        return 1.0
+    z = (u1 - mu - math.copysign(0.5, u1 - mu)) / math.sqrt(var)
+    return max(min(2.0 * (1.0 - _phi(abs(z))), 1.0), 0.0)
+
+
+def permutation_p(
+    a: Sequence[float], b: Sequence[float],
+    n_perm: int = PERMUTATION_N, seed: int = BOOTSTRAP_SEED,
+) -> float:
+    """Two-sided permutation test of the mean difference (seeded)."""
+    n1, n2 = len(a), len(b)
+    if n1 == 0 or n2 == 0:
+        return 1.0
+    pooled = np.asarray(list(a) + list(b), dtype=float)
+    observed = abs(float(np.mean(pooled[:n1]) - np.mean(pooled[n1:])))
+    rng = np.random.default_rng(seed)
+    hits = 0
+    for _ in range(n_perm):
+        perm = rng.permutation(pooled)
+        if abs(float(np.mean(perm[:n1]) - np.mean(perm[n1:]))) >= observed - 1e-15:
+            hits += 1
+    # +1 smoothing: a permutation p-value of exactly 0 overstates evidence.
+    return (hits + 1) / (n_perm + 1)
+
+
+def significance_p(a: Sequence[float], b: Sequence[float]) -> float:
+    """Rank test at window sizes; permutation test for tiny samples."""
+    if min(len(a), len(b)) < SMALL_SAMPLE_N:
+        return permutation_p(a, b)
+    return mann_whitney_p(a, b)
+
+
+def bootstrap_mean_ci(
+    samples: Sequence[float], *, confidence: float = 0.95,
+    n_boot: int = BOOTSTRAP_N, seed: int = BOOTSTRAP_SEED,
+) -> tuple:
+    """Seeded percentile-bootstrap CI on the mean of one sample."""
+    x = np.asarray(samples, dtype=float)
+    if x.size == 0:
+        return (float("nan"), float("nan"))
+    rng = np.random.default_rng(seed)
+    means = np.mean(
+        x[rng.integers(0, x.size, size=(n_boot, x.size))], axis=1
+    )
+    lo = (1.0 - confidence) / 2.0
+    return (float(np.quantile(means, lo)),
+            float(np.quantile(means, 1.0 - lo)))
+
+
+def bootstrap_delta_ci_pct(
+    base: Sequence[float], cand: Sequence[float], *,
+    confidence: float = 0.95, n_boot: int = BOOTSTRAP_N,
+    seed: int = BOOTSTRAP_SEED,
+) -> tuple:
+    """Seeded CI on the relative delta of means, in percent of baseline."""
+    a = np.asarray(base, dtype=float)
+    b = np.asarray(cand, dtype=float)
+    if a.size == 0 or b.size == 0 or float(np.mean(a)) == 0.0:
+        return (float("nan"), float("nan"))
+    rng = np.random.default_rng(seed)
+    am = np.mean(a[rng.integers(0, a.size, size=(n_boot, a.size))], axis=1)
+    bm = np.mean(b[rng.integers(0, b.size, size=(n_boot, b.size))], axis=1)
+    deltas = 100.0 * (bm - am) / am
+    lo = (1.0 - confidence) / 2.0
+    return (float(np.quantile(deltas, lo)),
+            float(np.quantile(deltas, 1.0 - lo)))
+
+
+def noise_floor_pct(values: Sequence[float]) -> float:
+    """Run-to-run noise estimate from repeated same-arm measurements.
+
+    2x the coefficient of variation (~95% band under roughly-normal
+    noise); falls back to DEFAULT_NOISE_FLOOR_PCT below 3 samples.
+    """
+    x = np.asarray(values, dtype=float)
+    x = x[np.isfinite(x)]
+    if x.size < 3 or float(np.mean(x)) == 0.0:
+        return DEFAULT_NOISE_FLOOR_PCT
+    cv = float(np.std(x) / abs(np.mean(x)))
+    return max(200.0 * cv, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Verdicts
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MetricComparison:
+    """One metric's baseline-vs-candidate outcome, with its evidence."""
+
+    metric: str
+    higher_is_better: bool
+    mode: str  # 'windows' | 'scalar'
+    n_base: int
+    n_cand: int
+    base_mean: float
+    cand_mean: float
+    delta_pct: float
+    ci_lo_pct: float
+    ci_hi_pct: float
+    p_value: Optional[float]
+    threshold_pct: float
+    verdict: str
+    note: str = ""
+
+    def summary(self) -> str:
+        ci = (f"CI95=[{self.ci_lo_pct:+.2f}%, {self.ci_hi_pct:+.2f}%]"
+              if math.isfinite(self.ci_lo_pct) else "CI95=[n/a]")
+        p = f" p={self.p_value:.4g}" if self.p_value is not None else ""
+        return (
+            f"metric={self.metric} delta={self.delta_pct:+.2f}% {ci}{p} "
+            f"threshold={self.threshold_pct:.2f}% verdict={self.verdict}"
+            + (f" ({self.note})" if self.note else "")
+        )
+
+
+def _classify(
+    delta_pct: float, ci_lo: float, ci_hi: float, p: Optional[float],
+    *, higher_is_better: bool, threshold_pct: float, alpha: float,
+) -> str:
+    """Shared verdict rule (see module docstring for the semantics)."""
+    if higher_is_better:
+        worse = delta_pct <= -threshold_pct
+        better = delta_pct >= threshold_pct
+        ci_excludes_zero_worse = math.isfinite(ci_hi) and ci_hi < 0.0
+        ci_excludes_zero_better = math.isfinite(ci_lo) and ci_lo > 0.0
+    else:
+        worse = delta_pct >= threshold_pct
+        better = delta_pct <= -threshold_pct
+        ci_excludes_zero_worse = math.isfinite(ci_lo) and ci_lo > 0.0
+        ci_excludes_zero_better = math.isfinite(ci_hi) and ci_hi < 0.0
+    significant = p is None or p < alpha
+    if worse and significant and ci_excludes_zero_worse:
+        return VERDICT_REGRESSION
+    if better and significant and ci_excludes_zero_better:
+        return VERDICT_IMPROVEMENT
+    return VERDICT_NEUTRAL
+
+
+def compare_distributions(
+    base: Sequence[float], cand: Sequence[float], *,
+    metric: str, higher_is_better: bool,
+    min_effect_pct: float = DEFAULT_MIN_EFFECT_PCT,
+    alpha: float = DEFAULT_ALPHA,
+    noise_pct: float = 0.0,
+) -> MetricComparison:
+    """Window-distribution comparison (the preferred mode)."""
+    threshold = max(min_effect_pct, noise_pct)
+    n1, n2 = len(base), len(cand)
+    if n1 < MIN_WINDOWS or n2 < MIN_WINDOWS:
+        return MetricComparison(
+            metric=metric, higher_is_better=higher_is_better,
+            mode="windows", n_base=n1, n_cand=n2,
+            base_mean=float(np.mean(base)) if n1 else float("nan"),
+            cand_mean=float(np.mean(cand)) if n2 else float("nan"),
+            delta_pct=float("nan"), ci_lo_pct=float("nan"),
+            ci_hi_pct=float("nan"), p_value=None,
+            threshold_pct=threshold, verdict=VERDICT_INSUFFICIENT,
+            note=f"need >= {MIN_WINDOWS} timed windows per side "
+                 f"(have {n1} vs {n2})",
+        )
+    bm, cm = float(np.mean(base)), float(np.mean(cand))
+    delta_pct = 100.0 * (cm - bm) / bm if bm else float("nan")
+    ci_lo, ci_hi = bootstrap_delta_ci_pct(base, cand)
+    p = significance_p(base, cand)
+    verdict = _classify(
+        delta_pct, ci_lo, ci_hi, p, higher_is_better=higher_is_better,
+        threshold_pct=threshold, alpha=alpha,
+    )
+    return MetricComparison(
+        metric=metric, higher_is_better=higher_is_better, mode="windows",
+        n_base=n1, n_cand=n2, base_mean=bm, cand_mean=cm,
+        delta_pct=delta_pct, ci_lo_pct=ci_lo, ci_hi_pct=ci_hi, p_value=p,
+        threshold_pct=threshold, verdict=verdict,
+    )
+
+
+def compare_scalars(
+    base_value: float, cand_value: float, *,
+    metric: str, higher_is_better: bool,
+    history: Sequence[float] = (),
+    min_effect_pct: float = DEFAULT_MIN_EFFECT_PCT,
+) -> MetricComparison:
+    """Scalar-vs-history comparison for runs without telemetry windows.
+
+    One number per side means no within-run distribution, so the
+    registry's same-arm history supplies the spread: the verdict band is
+    the noise floor around the baseline, and the reported interval is
+    the delta +/- that floor. No p-value is claimed — there is no test
+    statistic to compute from two scalars.
+    """
+    history = [v for v in history if v is not None]
+    noise = noise_floor_pct(history)
+    threshold = max(min_effect_pct, noise)
+    if base_value is None or cand_value is None or not base_value:
+        return MetricComparison(
+            metric=metric, higher_is_better=higher_is_better, mode="scalar",
+            n_base=1 if base_value is not None else 0,
+            n_cand=1 if cand_value is not None else 0,
+            base_mean=float(base_value or "nan"),
+            cand_mean=float(cand_value or "nan"),
+            delta_pct=float("nan"), ci_lo_pct=float("nan"),
+            ci_hi_pct=float("nan"), p_value=None, threshold_pct=threshold,
+            verdict=VERDICT_INSUFFICIENT, note="missing metric value",
+        )
+    delta_pct = 100.0 * (cand_value - base_value) / base_value
+    ci_lo, ci_hi = delta_pct - noise, delta_pct + noise
+    if len(history) < MIN_SCALAR_HISTORY:
+        # The delta is still reported (trend/triage value) but an
+        # unlearned noise floor must not verdict (see MIN_SCALAR_HISTORY).
+        verdict = VERDICT_INSUFFICIENT
+    else:
+        verdict = _classify(
+            delta_pct, ci_lo, ci_hi, None, higher_is_better=higher_is_better,
+            threshold_pct=threshold, alpha=DEFAULT_ALPHA,
+        )
+    return MetricComparison(
+        metric=metric, higher_is_better=higher_is_better, mode="scalar",
+        n_base=1, n_cand=1, base_mean=float(base_value),
+        cand_mean=float(cand_value), delta_pct=delta_pct,
+        ci_lo_pct=ci_lo, ci_hi_pct=ci_hi, p_value=None,
+        threshold_pct=threshold, verdict=verdict,
+        note=(
+            f"scalar mode, noise floor {noise:.2f}% "
+            f"from {len(history)} history runs"
+            + ("" if len(history) >= MIN_SCALAR_HISTORY else
+               f" — need >= {MIN_SCALAR_HISTORY} for a verdict")
+        ),
+    )
+
+
+def compare_records(
+    base_rec: Dict[str, Any], cand_rec: Dict[str, Any], *,
+    min_effect_pct: float = DEFAULT_MIN_EFFECT_PCT,
+    alpha: float = DEFAULT_ALPHA,
+    history: Sequence[float] = (),
+) -> List[MetricComparison]:
+    """Compare two registry records; first comparison is the gate metric.
+
+    Window mode when both records carry enough timed windows (primary:
+    per-window tokens/sec; secondary: step time); scalar mode against
+    registry history otherwise. Partial candidates/baselines are the
+    caller's (``regress.compare``) responsibility to refuse — this
+    function compares whatever it is handed.
+    """
+    out: List[MetricComparison] = []
+    b_tps = window_tokens_per_sec(base_rec)
+    c_tps = window_tokens_per_sec(cand_rec)
+    noise = noise_floor_pct(history) if history else 0.0
+    if len(b_tps) >= MIN_WINDOWS and len(c_tps) >= MIN_WINDOWS:
+        out.append(compare_distributions(
+            b_tps, c_tps, metric="tokens_per_sec", higher_is_better=True,
+            min_effect_pct=min_effect_pct, alpha=alpha, noise_pct=noise,
+        ))
+        out.append(compare_distributions(
+            window_step_times(base_rec), window_step_times(cand_rec),
+            metric="window_mean_step_time_sec", higher_is_better=False,
+            min_effect_pct=min_effect_pct, alpha=alpha, noise_pct=noise,
+        ))
+        return out
+    bm = (base_rec.get("metric") or {})
+    cm = (cand_rec.get("metric") or {})
+    name = cm.get("name") or bm.get("name") or "metric"
+    out.append(compare_scalars(
+        bm.get("value"), cm.get("value"), metric=name,
+        higher_is_better=bool(cm.get("higher_is_better", True)),
+        history=history, min_effect_pct=min_effect_pct,
+    ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Telemetry-file comparison (analysis.telemetry_report --compare)
+# ---------------------------------------------------------------------------
+
+
+def compare_telemetry(
+    events_a: Sequence[Dict[str, Any]], events_b: Sequence[Dict[str, Any]], *,
+    min_effect_pct: float = DEFAULT_MIN_EFFECT_PCT,
+    alpha: float = DEFAULT_ALPHA,
+) -> Dict[str, Any]:
+    """Two telemetry JSONL event streams -> per-phase + per-window deltas.
+
+    The ROADMAP's ``telemetry_report --compare A B`` regression-triage
+    mode: phase-time attribution deltas (where did the extra wall time
+    go) plus the window-distribution comparisons on the timed phase
+    (did the step itself get slower, with what confidence).
+    """
+    from ..analysis.telemetry_report import build_timeline
+    from ..telemetry import PHASES
+
+    tla, tlb = build_timeline(list(events_a)), build_timeline(list(events_b))
+    phases: List[Dict[str, Any]] = []
+    present = set(tla["phase_times"]) | set(tlb["phase_times"])
+    ordered = [ph for ph in PHASES if ph in present] + sorted(
+        present - set(PHASES)
+    )
+    for phase in ordered:
+        a = tla["phase_times"].get(phase)
+        b = tlb["phase_times"].get(phase)
+        phases.append({
+            "phase": phase, "a_sec": a, "b_sec": b,
+            "delta_sec": (b - a) if (a is not None and b is not None) else None,
+            "delta_pct": (100.0 * (b - a) / a)
+            if (a and b is not None) else None,
+        })
+    wa, wb = timed_windows(events_a), timed_windows(events_b)
+    meta_a, meta_b = tla["meta"], tlb["meta"]
+    comparisons: List[MetricComparison] = [compare_distributions(
+        [w["dt"] for w in wa], [w["dt"] for w in wb],
+        metric="window_mean_step_time_sec", higher_is_better=False,
+        min_effect_pct=min_effect_pct, alpha=alpha,
+    )]
+    tps_a = int(meta_a.get("tokens_per_step", 0) or 0)
+    tps_b = int(meta_b.get("tokens_per_step", 0) or 0)
+    if tps_a > 0 and tps_b > 0:
+        comparisons.insert(0, compare_distributions(
+            [tps_a / w["dt"] for w in wa], [tps_b / w["dt"] for w in wb],
+            metric="tokens_per_sec", higher_is_better=True,
+            min_effect_pct=min_effect_pct, alpha=alpha,
+        ))
+    return {
+        "a": {"arm": meta_a.get("arm"), "wall": tla["wall"],
+              "n_timed_windows": len(wa)},
+        "b": {"arm": meta_b.get("arm"), "wall": tlb["wall"],
+              "n_timed_windows": len(wb)},
+        "phases": phases,
+        "comparisons": comparisons,
+    }

@@ -191,4 +191,9 @@ fi
 echo "=== Launching Training ==="
 echo "Command: python -u /app/benchmarking/train_harness.py ${ARGS}"
 echo ""
+# The k8s livenessProbe (scripts/liveness_probe.sh) reads run progress
+# from the flight recorder's telemetry JSONL under $RESULTS_DIR — the
+# stdout stream stays untouched (interposing a tee on PID 1's stdout
+# risks losing the final result markers in the teardown race), and exec
+# keeps python as PID 1.
 exec python -u /app/benchmarking/train_harness.py ${ARGS}

@@ -33,6 +33,11 @@ OFFLOAD_DELAYED_UPDATE=0
 OFFLOAD_DPU_START_STEP=0
 CAUSAL=0
 RING_ZIGZAG="auto"
+# Flight-recorder heartbeat cadence (harness --heartbeat-sec); also drives
+# the job's livenessProbe — the probe period tracks the cadence and its
+# grace window is derived inside scripts/liveness_probe.sh (10x, floor
+# 120s), so one knob moves scrape cadence and liveness together.
+HEARTBEAT_SEC="${HEARTBEAT_SEC:-30}"
 IMAGE="tpu-llm-bench:latest"
 TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
@@ -65,6 +70,7 @@ while [ $# -gt 0 ]; do
     --offload-dpu-start-step) OFFLOAD_DPU_START_STEP="$2"; shift 2 ;;
     --causal) CAUSAL=1; shift 1 ;;
     --ring-zigzag) RING_ZIGZAG="$2"; shift 2 ;;
+    --heartbeat-sec) HEARTBEAT_SEC="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
     --job-name) JOB_NAME="$2"; shift 2 ;;
@@ -80,6 +86,10 @@ if [ $(( TPU_PER_HOST * NUM_HOSTS )) -ne "$WORLD_SIZE" ]; then
   echo "ERROR: world-size $WORLD_SIZE not divisible by num-hosts $NUM_HOSTS"; exit 1
 fi
 
+# Liveness probe period tracks the heartbeat cadence, with a floor so a
+# tight test cadence doesn't hammer kubelet exec.
+LIVENESS_PERIOD="$HEARTBEAT_SEC"
+if [ "$LIVENESS_PERIOD" -lt 10 ] 2>/dev/null; then LIVENESS_PERIOD=10; fi
 echo "Launching: job=$JOB_NAME strategy=$STRATEGY world_size=$WORLD_SIZE hosts=$NUM_HOSTS"
 kubectl apply -f k8s/namespace.yaml
 kubectl apply -f k8s/serviceaccount.yaml
@@ -111,6 +121,8 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{OFFLOAD_DPU_START_STEP}}|$OFFLOAD_DPU_START_STEP|g" \
     -e "s|{{CAUSAL}}|$CAUSAL|g" \
     -e "s|{{RING_ZIGZAG}}|$RING_ZIGZAG|g" \
+    -e "s|{{HEARTBEAT_SEC}}|$HEARTBEAT_SEC|g" \
+    -e "s|{{LIVENESS_PERIOD}}|$LIVENESS_PERIOD|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \
     -e "s|{{TPU_ACCELERATOR}}|$TPU_ACCELERATOR|g" \
     -e "s|{{TPU_TOPOLOGY}}|$TPU_TOPOLOGY|g" \

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# k8s livenessProbe exec: is the benchmark still making progress?
+#
+# ROADMAP telemetry follow-up (b): the flight recorder writes at every
+# sync-window boundary — `BENCHMARK_HEARTBEAT` stdout markers on the
+# --heartbeat-sec cadence, and (a superset of that cadence) `step_window`
+# events into the line-buffered telemetry_<arm>.jsonl beside the results.
+# An exec probe cannot read the pod's own stdout stream, and interposing
+# a tee on PID 1's stdout risks losing the final result markers in the
+# container-teardown race — so the probe reads the recorder's OTHER
+# channel: the newest telemetry JSONL under $RESULTS_DIR (pod emptyDir).
+# A mirror file at $BENCH_LOG with heartbeat lines is honored first when
+# an operator does maintain one (non-k8s supervisors).
+#
+# The probe fails when the freshest event timestamp is older than the
+# grace window:
+#
+#     grace = $LIVENESS_GRACE_SEC, default 10 x $HEARTBEAT_SEC (floor 120s)
+#
+# 10x, not 2x: events only fire at sync-window boundaries, so an arm
+# whose windows outlast the nominal cadence (big models, sync_every x
+# slow steps) legitimately writes slower than --heartbeat-sec. The floor
+# keeps a sub-second test cadence from flapping the pod.
+#
+# Before the FIRST event the probe succeeds unconditionally: init and XLA
+# compile can run many minutes with no telemetry, and killing a pod
+# mid-compile would turn every cold start into a CrashLoop. A pod hung
+# before its first sync window is bounded by the Job's
+# activeDeadline/backoff, not by this probe. Telemetry disabled
+# (TELEMETRY=false) likewise means no signal — the probe stays quiet
+# rather than killing a healthy run.
+#
+# Exit 0 = alive, 1 = stalled (kubelet restarts the container). Pinned by
+# tests/test_regress.py (fresh/stale/absent/torn cases, both channels).
+set -euo pipefail
+
+BENCH_LOG="${BENCH_LOG:-/tmp/bench.log}"
+RESULTS_DIR="${RESULTS_DIR:-/results}"
+HEARTBEAT_SEC="${HEARTBEAT_SEC:-30}"
+# An empty HEARTBEAT_SEC env (the template's "use harness default") means
+# the recorder's 30s default.
+if [ -z "$HEARTBEAT_SEC" ]; then HEARTBEAT_SEC=30; fi
+GRACE="${LIVENESS_GRACE_SEC:-}"
+if [ -z "$GRACE" ]; then
+  GRACE=$(( HEARTBEAT_SEC * 10 ))
+  if [ "$GRACE" -lt 120 ]; then GRACE=120; fi
+fi
+
+# Channel 1: an operator-maintained stdout mirror with heartbeat markers.
+LAST_JSON=""
+if [ -f "$BENCH_LOG" ]; then
+  LAST_JSON=$(grep -a '^BENCHMARK_HEARTBEAT {' "$BENCH_LOG" | tail -1 \
+              | sed 's/^BENCHMARK_HEARTBEAT //' || true)
+fi
+
+# Channel 2: the newest telemetry JSONL's last line (every event carries
+# a wall-clock `ts` — the schema contract, telemetry/recorder.py).
+if [ -z "$LAST_JSON" ] && [ -d "$RESULTS_DIR" ]; then
+  NEWEST=$(ls -1t "$RESULTS_DIR"/telemetry_*.jsonl 2>/dev/null | head -1 \
+           || true)
+  if [ -n "$NEWEST" ]; then
+    LAST_JSON=$(tail -1 "$NEWEST" || true)
+  fi
+fi
+
+# No signal yet: startup (or telemetry off) — alive.
+if [ -z "$LAST_JSON" ]; then exit 0; fi
+
+TS=$(printf '%s' "$LAST_JSON" \
+     | python3 -c 'import json,sys; print(int(float(json.load(sys.stdin)["ts"])))' \
+     2>/dev/null) || exit 0  # torn line mid-write: not evidence of a hang
+NOW=$(date +%s)
+AGE=$(( NOW - TS ))
+if [ "$AGE" -gt "$GRACE" ]; then
+  echo "liveness: last telemetry event ${AGE}s ago > grace ${GRACE}s" >&2
+  exit 1
+fi
+exit 0

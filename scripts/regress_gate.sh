@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# regress wrapper: the run-registry regression gate, runnable standalone
+# (the k8s image carries it via the scripts/ COPY) and called by
+# run_all_benchmarks.sh in its finish path — the graftcheck.sh analogue
+# for the statistical layer (docs/REGRESSION.md).
+#
+# No args = gate every arm's latest run against its last known good; any
+# args are passed through to the CLI, e.g.
+#   scripts/regress_gate.sh ingest --results-dir results
+#   scripts/regress_gate.sh trend bench_tinygpt_tierA_seq2048 --png t.png
+#   scripts/regress_gate.sh compare last-good latest --arm <arm>
+# Exit codes mirror graftcheck: 0 clean, 1 regression, 2 operational
+# (schema drift, unknown record).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if [ $# -eq 0 ]; then set -- gate --all; fi
+exec python -m distributed_llm_training_benchmark_framework_tpu.regress "$@"

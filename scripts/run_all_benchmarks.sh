@@ -79,6 +79,20 @@ SUITE_DRY_RUN="${SUITE_DRY_RUN:-0}"
 # SKIP_PREFLIGHT=1 bypasses (same escape hatch as bench.py's
 # --skip-preflight); dry runs plan only and skip it too.
 SKIP_PREFLIGHT="${SKIP_PREFLIGHT:-0}"
+# Run-registry + regression gate (regress/, docs/REGRESSION.md): the finish
+# path ingests every arm's result row + telemetry windows into the
+# persistent registry and gates each arm's fresh run against its last known
+# good — a statistically significant throughput regression fails the suite
+# the same way a validation violation does. SKIP_REGRESS=1 bypasses; dry
+# runs never reach it. The default registry root rides under RESULTS_DIR
+# (the default RESULTS_DIR is the repo's persistent results/, so history
+# accumulates across suite invocations there; hermetic runs that point
+# RESULTS_DIR elsewhere stay self-contained) — pin REGISTRY_DIR to share
+# one registry across differently-rooted suites. The default is resolved
+# AFTER the flag loop below: --results-dir must redirect the registry
+# too, or a flag-redirected CI run would dirty the repo's committed
+# seed and gate against unrelated history.
+SKIP_REGRESS="${SKIP_REGRESS:-0}"
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -92,6 +106,8 @@ while [ $# -gt 0 ]; do
     *) echo "unknown flag $1"; exit 1 ;;
   esac
 done
+
+REGISTRY_DIR="${REGISTRY_DIR:-$RESULTS_DIR/registry}"
 
 if [ "$MODE" = "k8s" ] && [ -n "$EXTRA_ARGS" ]; then
   # launch_multi.sh/the job template don't carry arbitrary flags; silently
@@ -274,6 +290,26 @@ echo "=== Validation (sanity envelopes, results/example_output/README.md) ==="
 python -m distributed_llm_training_benchmark_framework_tpu.analysis.validate_results \
   --results-dir "$RESULTS_DIR" --logs-dir "$RESULTS_DIR" \
   || { echo "VALIDATION FAILED"; FAIL=$((FAIL+1)); }
+
+if [ "$SKIP_REGRESS" != "1" ]; then
+  echo ""
+  echo "=== Regression gate (registry: $REGISTRY_DIR) ==="
+  # Ingest first (full rows as ok, heartbeat partials as partial), then
+  # gate every arm's latest vs its last known good. A first-ever run on a
+  # fresh registry gates clean (insufficient-data is not a failure).
+  python -m distributed_llm_training_benchmark_framework_tpu.regress \
+    --registry "$REGISTRY_DIR" ingest --results-dir "$RESULTS_DIR" \
+    || { echo "REGISTRY INGEST FAILED"; FAIL=$((FAIL+1)); }
+  python -m distributed_llm_training_benchmark_framework_tpu.regress \
+    --registry "$REGISTRY_DIR" gate --all \
+    || { echo "REGRESSION GATE FAILED (SKIP_REGRESS=1 to override)"; \
+         FAIL=$((FAIL+1)); }
+  # Refresh the report with the per-arm trend section now that the
+  # registry carries this suite's records.
+  python -m distributed_llm_training_benchmark_framework_tpu.analysis.make_report \
+    --csv "$SUMMARY/metrics.csv" --out "$SUMMARY" --plots-dir ../plots \
+    --registry "$REGISTRY_DIR" || true
+fi
 
 echo ""
 echo "=== Suite complete: $PASS passed, $FAIL failed, $(( $(date +%s) - SUITE_START ))s total ==="

@@ -18,6 +18,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 
 import pytest
 
@@ -34,6 +35,12 @@ def run_bench(*extra):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    # Hermeticity: bench.py auto-ingests its rows into the regress
+    # registry when one exists (the repo ships a seeded results/registry)
+    # — point it at a throwaway root so smoke runs never append test
+    # records to the committed history. The registry behavior itself is
+    # covered by tests/test_regress.py.
+    env["REGRESS_REGISTRY"] = tempfile.mkdtemp(prefix="bench_registry_")
     proc = subprocess.run(
         [sys.executable, BENCH, *SMOKE_ARGS, *extra],
         capture_output=True, text=True, env=env, timeout=900, cwd=REPO,
