@@ -251,11 +251,23 @@ def build_report(
             f"seq {int(best_cost['seq_len'])})"
         )
     if has_partial:
-        n_partial = int(df["partial"].fillna(False).astype(bool).sum())
+        is_partial = df["partial"].fillna(False).astype(bool)
+        n_partial = int(is_partial.sum())
+        # Preemption vs crash (chaos round): a preempted arm left an
+        # emergency checkpoint and resumes on retry; a crashed one needs
+        # triage. The collect script stamps `reason` from the final
+        # heartbeat (emergency heartbeats carry reason=preempted).
+        death = ""
+        if "reason" in df.columns:
+            n_pre = int(
+                (df.loc[is_partial, "reason"] == "preempted").sum()
+            )
+            death = (f" ({n_pre} preempted with an emergency checkpoint, "
+                     f"{n_partial - n_pre} crashed)")
         out.append(
             f"- **Partial rows:** {n_partial} arm(s) died before their "
             "final result marker; their rows come from heartbeat salvage "
-            "(last sync window) — see the `partial` column."
+            f"(last sync window){death} — see the `partial` column."
         )
     out.append("")
 

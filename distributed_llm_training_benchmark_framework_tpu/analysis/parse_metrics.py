@@ -71,6 +71,13 @@ def _partial_row(p: dict) -> dict:
         row["mean_loss"] = p["loss"]
     row["last_step"] = p.get("step")
     row["partial"] = True
+    # Death classification + stitched-run accounting (chaos round): the
+    # collect script stamps reason=preempted|crash, and a resumed arm's
+    # heartbeats carry resumed/n_restarts — the report separates a
+    # preempted pod (checkpointed, resumable) from a genuine crash.
+    for k in ("reason", "resumed", "n_restarts"):
+        if k in p:
+            row[k] = p[k]
     return row
 
 

@@ -24,6 +24,12 @@ deployment invariant this codebase has already paid for once:
          heartbeats, and the ONLY sanctioned cadence is the sync-window
          boundary — unfenced host IO mid-window lands inside the very
          step times the loop publishes.
+- GC106  signal-handler installation or blocking file IO (fsync-class)
+         inside the timed ``for step`` loop of ``train/loop.py``: the
+         SIGTERM preemption handler must be installed OUTSIDE the loop
+         (a handler interrupting arbitrary bytecode mid-commit is how
+         torn state happens), and fsync/fdatasync block the host thread
+         for device-unrelated milliseconds inside published step times.
 - GC201  entrypoint<->harness flag-surface drift (PR 1's detector, now a
          registry rule): every ``train/harness.py`` flag must be reachable
          from the container env in ``docker/entrypoint.sh`` and vice versa.
@@ -135,6 +141,86 @@ def _dotted(node: ast.AST) -> Optional[str]:
 _SUPPRESS = re.compile(r"#\s*graftcheck:\s*disable=([A-Za-z0-9_,\s]+)")
 
 
+def _timed_loops(tree_ast: ast.AST) -> Iterator[ast.For]:
+    """Every `for step in ...` loop — the timed-loop shape GC102/105/106
+    police in train/loop.py."""
+    for n in ast.walk(tree_ast):
+        if (
+            isinstance(n, ast.For)
+            and isinstance(n.target, ast.Name)
+            and n.target.id == "step"
+        ):
+            yield n
+
+
+def _contains_sync(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _dotted(n.func) in (
+            "sync_window", "self.sync_window"
+        ):
+            return True
+    return False
+
+
+def _stmt_calls(stmt: ast.AST) -> Iterator[ast.Call]:
+    """Calls directly in ``stmt``, excluding nested function defs
+    (sync_window-style boundary helpers are the sanctioned fenced
+    context themselves)."""
+    stack = [stmt]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _iter_timed_loop_calls(tree: "_Tree") -> Iterator[Tuple[ast.Call, bool]]:
+    """(call, fenced) for every call inside the file's timed loops.
+
+    The ONE fence walk GC105 and GC106 share (a fix to its semantics must
+    never be applied twice): statement-ordered traversal where a
+    statement whose subtree calls ``sync_window`` fences everything AFTER
+    it in the same block (and in blocks nested under those later
+    statements); compound statements pass the current flag down to their
+    bodies, and their test/iter/with-item expressions are scanned
+    directly (``with open(...)`` is IO too). Conservative in the right
+    direction: a fence from a previous loop iteration never carries over.
+    Rules decide what the flag means — GC105 ignores fenced calls
+    entirely, GC106 flags signal installs through fences.
+    """
+
+    def walk_block(stmts, fenced: bool):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.If, ast.With, ast.Try, ast.For,
+                                 ast.While)):
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        yield from walk_block(sub, fenced)
+                for handler in getattr(stmt, "handlers", []):
+                    yield from walk_block(handler.body, fenced)
+                scan_nodes = [getattr(stmt, "test", None),
+                              getattr(stmt, "iter", None)]
+                scan_nodes += [
+                    item.context_expr for item in getattr(stmt, "items", [])
+                ]
+                calls = [
+                    c for n in scan_nodes if n is not None
+                    for c in _stmt_calls(n)
+                ]
+            else:
+                calls = list(_stmt_calls(stmt))
+            for call in calls:
+                yield call, fenced
+            if _contains_sync(stmt):
+                fenced = True
+
+    for loop in _timed_loops(tree.ast):
+        yield from walk_block(loop.body, False)
+
+
 def _suppressed(tree: _Tree, line: int, rule_id: str) -> bool:
     for ln in (line, line - 1):
         if 1 <= ln <= len(tree.lines):
@@ -199,29 +285,14 @@ def _check_timed_loop_syncs(root: str) -> Iterator[Violation]:
         return
     tree = _Tree(path, os.path.relpath(path, root))
 
-    def timed_loops(node):
-        for n in ast.walk(node):
-            if (
-                isinstance(n, ast.For)
-                and isinstance(n.target, ast.Name)
-                and n.target.id == "step"
-            ):
-                yield n
-
     def body_calls(for_node):
-        # Lexical scope only: nested function defs (sync_window-style
-        # helpers invoked at sync boundaries) are the sanctioned place for
-        # the sync itself.
-        stack = list(for_node.body)
-        while stack:
-            n = stack.pop()
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if isinstance(n, ast.Call):
-                yield n
-            stack.extend(ast.iter_child_nodes(n))
+        # Lexical scope only (no fence concept: a host sync is hostile at
+        # ANY cadence inside the loop body — fenced syncs live INSIDE the
+        # sync_window helper, which _stmt_calls excludes as a nested def).
+        for stmt in for_node.body:
+            yield from _stmt_calls(stmt)
 
-    for loop in timed_loops(tree.ast):
+    for loop in _timed_loops(tree.ast):
         for call in body_calls(loop):
             name = _dotted(call.func)
             kind = None
@@ -287,85 +358,79 @@ def _check_timed_loop_telemetry_io(root: str) -> Iterator[Violation]:
     if not os.path.exists(path):
         return
     tree = _Tree(path, os.path.relpath(path, root))
+    for call, fenced in _iter_timed_loop_calls(tree):
+        if fenced:
+            continue
+        kind = _is_telemetry_io_call(call)
+        if kind and not _suppressed(tree, call.lineno, "GC105"):
+            yield Violation(
+                "GC105", tree.rel, call.lineno,
+                f"{kind} inside the timed step loop with no "
+                "sync_window fence earlier in its block",
+                RULES["GC105"].fix_hint,
+            )
 
-    def timed_loops(node):
-        for n in ast.walk(node):
-            if (
-                isinstance(n, ast.For)
-                and isinstance(n.target, ast.Name)
-                and n.target.id == "step"
-            ):
-                yield n
 
-    def contains_sync(node) -> bool:
-        for n in ast.walk(node):
-            if isinstance(n, ast.Call) and _dotted(n.func) in (
-                "sync_window", "self.sync_window"
-            ):
-                return True
-        return False
+# ---------------------------------------------------------------------------
+# GC106: signal handlers / blocking file IO in the timed loop
+# ---------------------------------------------------------------------------
 
-    def stmt_calls(stmt):
-        """IO calls directly in ``stmt``, excluding nested function defs
-        (sync_window-style helpers are the sanctioned boundary itself)."""
-        stack = [stmt]
-        while stack:
-            n = stack.pop()
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if isinstance(n, ast.Call):
-                yield n
-            stack.extend(ast.iter_child_nodes(n))
+#: Handler-installation calls: flagged ANYWHERE inside the timed loop,
+#: fenced or not — a handler swap has no business at any step cadence
+#: (install once, outside; faults/preemption.py is the sanctioned home).
+_SIGNAL_CALLS = frozenset({
+    "signal.signal", "signal.setitimer", "signal.siginterrupt",
+    "signal.pthread_sigmask", "signal.sigwait", "signal.sigtimedwait",
+})
+#: Blocking file IO: flagged unless fenced by a sync_window earlier in
+#: the block (same fence rule as GC105's telemetry IO).
+_BLOCKING_IO_CALLS = frozenset({
+    "os.fsync", "os.fdatasync", "os.sync",
+    "shutil.copy", "shutil.copy2", "shutil.copytree", "shutil.move",
+})
 
-    def walk_block(stmts, fenced: bool) -> Iterator[Violation]:
-        """Statement-ordered traversal with a per-block fence flag.
 
-        A statement whose subtree calls ``sync_window`` fences everything
-        AFTER it in the same block (and in blocks nested under those later
-        statements); compound statements pass the current flag down to
-        their bodies. Conservative in the right direction: a fence from a
-        previous loop iteration never carries over.
-        """
-        for stmt in stmts:
-            if not fenced:
-                if isinstance(stmt, (ast.If, ast.With, ast.Try, ast.For,
-                                     ast.While)):
-                    # Recurse into compound bodies with the running flag;
-                    # plain statements are scanned directly.
-                    for field in ("body", "orelse", "finalbody"):
-                        sub = getattr(stmt, field, None)
-                        if sub:
-                            yield from walk_block(sub, fenced)
-                    for handler in getattr(stmt, "handlers", []):
-                        yield from walk_block(handler.body, fenced)
-                    # The test/iter/with-items expressions still get a
-                    # direct scan — `with open(...)` is IO too.
-                    scan_nodes = [getattr(stmt, "test", None),
-                                  getattr(stmt, "iter", None)]
-                    scan_nodes += [
-                        item.context_expr
-                        for item in getattr(stmt, "items", [])
-                    ]
-                    calls = [
-                        c for n in scan_nodes if n is not None
-                        for c in stmt_calls(n)
-                    ]
-                else:
-                    calls = list(stmt_calls(stmt))
-                for call in calls:
-                    kind = _is_telemetry_io_call(call)
-                    if kind and not _suppressed(tree, call.lineno, "GC105"):
-                        yield Violation(
-                            "GC105", tree.rel, call.lineno,
-                            f"{kind} inside the timed step loop with no "
-                            "sync_window fence earlier in its block",
-                            RULES["GC105"].fix_hint,
-                        )
-            if contains_sync(stmt):
-                fenced = True
-
-    for loop in timed_loops(tree.ast):
-        yield from walk_block(loop.body, fenced=False)
+@_rule(
+    "GC106",
+    "signal-handler-or-blocking-io-in-timed-loop",
+    "signal-handler installation (anywhere) or unfenced blocking file IO "
+    "(fsync-class) inside the timed `for step` loop of train/loop.py — "
+    "the SIGTERM handler must live outside the loop (faults.PreemptionGuard "
+    "installs it before the first dispatch), and fsync blocks the host "
+    "thread inside published step times",
+    "install signal handlers once, before the loop (faults/preemption.py); "
+    "move fsync-class IO behind a sync_window fence (runtime/checkpoint.py "
+    "owns durable writes at checkpoint boundaries); suppress deliberate "
+    "exceptions with '# graftcheck: disable=GC106'",
+)
+def _check_timed_loop_signal_and_blocking_io(root: str) -> Iterator[Violation]:
+    path = os.path.join(root, PACKAGE, "train", "loop.py")
+    if not os.path.exists(path):
+        return
+    tree = _Tree(path, os.path.relpath(path, root))
+    # Same fence walk as GC105 (shared _iter_timed_loop_calls); the rules
+    # differ only in classification — signal installs ignore the fence.
+    for call, fenced in _iter_timed_loop_calls(tree):
+        name = _dotted(call.func)
+        if name in _SIGNAL_CALLS:
+            if not _suppressed(tree, call.lineno, "GC106"):
+                yield Violation(
+                    "GC106", tree.rel, call.lineno,
+                    f"{name}(...) installs/changes a signal handler "
+                    "inside the timed step loop",
+                    RULES["GC106"].fix_hint,
+                )
+        elif (
+            name in _BLOCKING_IO_CALLS and not fenced
+            and not _suppressed(tree, call.lineno, "GC106")
+        ):
+            yield Violation(
+                "GC106", tree.rel, call.lineno,
+                f"{name}(...) is blocking file IO inside the timed "
+                "step loop with no sync_window fence earlier in its "
+                "block",
+                RULES["GC106"].fix_hint,
+            )
 
 
 # ---------------------------------------------------------------------------

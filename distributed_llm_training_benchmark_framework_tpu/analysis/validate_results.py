@@ -108,6 +108,14 @@ OFFLOAD_STEP_CV_LIMIT_PCT = 25.0
 # artifacts) skip the check.
 LOSS_DESCENT_MIN_STEPS = 50
 LOSS_DESCENT_DELTA = {"tinygpt": 0.25, "llama": 0.15}
+# Resume-continuity envelope (chaos round, docs/FAULT_TOLERANCE.md): a
+# resumed row records the loss its checkpoint was saved at
+# (resume_baseline_loss); the post-resume first window must land near it.
+# A cold restart POSING as a resume starts back at the ~ln(V) random-init
+# ceiling — several nats above any mid-training checkpoint — so a modest
+# absolute slack separates the two cleanly while tolerating the genuine
+# wobble of an optimizer restart.
+RESUME_LOSS_CONT_SLACK = 1.5
 # Flight-recorder phase-attribution envelope (round 8): the recorder's
 # phases are sequential and disjoint by construction, so the published
 # time_in_* fields must be non-negative and their sum must not exceed the
@@ -177,7 +185,15 @@ def validate_result(r: dict, name: str) -> List[str]:
             f"{r['steps']} steps) — the run did not train", f,
         )
 
-    if r.get("sync_every", 1) == 1 and r.get("step_time_cv_pct", 0) > 0:
+    # Resumed (stitched) rows: the first timed window after a restore
+    # folds in the recompile (the loop's timed-first-step shape), so the
+    # CV envelope is not a device-stability signal there. The stitch is
+    # policed by its own continuity check below — and resumed rows are
+    # never regression baselines anyway (regress.store).
+    if (
+        r.get("sync_every", 1) == 1 and r.get("step_time_cv_pct", 0) > 0
+        and not r.get("resumed")
+    ):
         cv = r["step_time_cv_pct"]
         cv_limit = (
             OFFLOAD_STEP_CV_LIMIT_PCT if r.get("offload_opt_state")
@@ -187,6 +203,33 @@ def validate_result(r: dict, name: str) -> List[str]:
             cv < cv_limit, name,
             f"step-time cv {cv:.1f}% >= {cv_limit}% envelope"
             + (" (offload allowance)" if r.get("offload_opt_state") else ""), f,
+        )
+
+    # Stitched-run honesty (chaos round): a row claiming resumed=true must
+    # carry a coherent restart ledger, and its post-resume loss must be
+    # CONTINUOUS with the checkpoint it claims to extend — a cold restart
+    # mislabeled as a resume restarts at the random-init ceiling and is
+    # rejected here.
+    if r.get("resumed"):
+        if "n_restarts" in r:
+            _check(
+                int(r.get("n_restarts") or 0) >= 1, name,
+                f"resumed=true but n_restarts={r.get('n_restarts')} "
+                "(the restart ledger must count at least the one resume)", f,
+            )
+        baseline = r.get("resume_baseline_loss", 0.0) or 0.0
+        if baseline > 0 and first_w > 0:
+            _check(
+                first_w <= baseline + RESUME_LOSS_CONT_SLACK, name,
+                f"loss_first_window={first_w:.4f} is discontinuous with "
+                f"resume_baseline_loss={baseline:.4f} (+{RESUME_LOSS_CONT_SLACK} "
+                "slack) — the run did not actually continue from its "
+                "checkpoint", f,
+            )
+    elif int(r.get("n_restarts") or 0) > 0:
+        f.append(
+            f"{name}: n_restarts={r.get('n_restarts')} on a row with "
+            "resumed=false — restart accounting is incoherent"
         )
 
     # MFU floors for the published-arm geometry only: tier A, single chip,

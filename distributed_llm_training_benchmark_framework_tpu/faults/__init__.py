@@ -1,0 +1,49 @@
+"""Chaos harness: deterministic fault injection + preemption machinery.
+
+Production TPU fleets live with preemption as a steady-state event, and a
+benchmark that cannot survive one publishes a lie by omission: every
+mid-run death becomes a vanished (or, since the flight recorder, a
+partial) row, and nothing ever *proves* the recovery path works. This
+package is the proving ground:
+
+- :mod:`.injection` — a registry of injectable faults (``sigkill@N``,
+  ``sigterm@N``, ``nan-loss@N``, ``hang@N``, ``torn-checkpoint``,
+  ``enospc-on-save``), armed via the harness ``--inject-fault`` flag or
+  the ``INJECT_FAULT`` env var, each firing at an exact sync-window
+  boundary so a chaos run aborts at the same step every time.
+- :mod:`.preemption` — the SIGTERM-to-emergency-checkpoint guard the
+  train loop polls at sync boundaries, the :class:`Preempted` control
+  exception, and the distinct ``EXIT_PREEMPTED`` process exit code the
+  retrying orchestration keys on.
+
+``scripts/chaos_suite.sh`` drives the full fault matrix end to end and
+asserts every class lands in a completed, validated result (after
+resume) or a correctly classified partial — docs/FAULT_TOLERANCE.md is
+the operator contract.
+"""
+
+from .injection import (  # noqa: F401
+    FAULT_KINDS,
+    FaultInjector,
+    FaultSpec,
+    parse_fault_spec,
+)
+from .preemption import (  # noqa: F401
+    EXIT_NOTHING_TO_RESUME,
+    EXIT_PREEMPTED,
+    NothingToResume,
+    Preempted,
+    PreemptionGuard,
+)
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultInjector",
+    "FaultSpec",
+    "parse_fault_spec",
+    "EXIT_NOTHING_TO_RESUME",
+    "EXIT_PREEMPTED",
+    "NothingToResume",
+    "Preempted",
+    "PreemptionGuard",
+]

@@ -1,0 +1,260 @@
+"""Deterministic fault injection for the chaos harness.
+
+Every fault fires at an exact, *reproducible* point in the run:
+
+- the stepped kinds (``sigkill@N``, ``sigterm@N``, ``hang@N``) fire at
+  the first sync-window boundary whose last completed step is >= N — the
+  loop is already fenced there, so the abort step in the telemetry trail
+  is the same on every run of the same spec;
+- ``nan-loss@N`` corrupts exactly step N's loss at dispatch (the NaN
+  surfaces at that step's sync window and trips the recorder's anomaly
+  screen);
+- ``torn-checkpoint`` fires after the first checkpoint save that leaves
+  a *previous* committed step behind it: it tears the newest step's
+  payload (truncates one file) and SIGKILLs, so resume must quarantine
+  the torn step and fall back;
+- ``enospc-on-save`` raises ``OSError(ENOSPC)`` from every checkpoint
+  save — the run must degrade (warn + telemetry event) and still finish.
+
+The injector is inert (``armed`` False) when constructed without a spec,
+so the hot loop pays one attribute check per boundary and nothing else.
+Faults announce themselves with a ``fault_injected`` telemetry event
+*before* firing — the JSONL stream is line-buffered, so even the SIGKILL
+trail records what killed it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import os
+import signal
+import time
+from typing import Optional
+
+#: kind -> one-line contract, the registry --inject-fault validates against.
+FAULT_KINDS = {
+    "sigkill": "SIGKILL self at the first sync boundary with step >= N "
+               "(the honest crash: no handlers, no flushes)",
+    "sigterm": "SIGTERM self at the first sync boundary with step >= N "
+               "(exercises the preemption handler end to end)",
+    "nan-loss": "corrupt step N's loss to NaN (trips the recorder's "
+                "anomaly screen; validate_results must reject the row)",
+    "hang": "sleep at the first sync boundary with step >= N "
+            "(hang@N:SECS overrides the default stall; exercises "
+            "timeouts / the liveness probe)",
+    "torn-checkpoint": "tear the newest checkpoint after a save that has "
+                       "a previous committed step, then SIGKILL (restore "
+                       "must quarantine and fall back)",
+    "enospc-on-save": "every checkpoint save raises OSError(ENOSPC); the "
+                      "run must degrade and still finish",
+}
+
+#: Kinds that take a mandatory ``@N`` step.
+STEPPED_KINDS = frozenset({"sigkill", "sigterm", "nan-loss", "hang"})
+
+#: Default stall for ``hang`` when the spec carries no ``:SECS``. Long
+#: enough that any sane per-run timeout (or the k8s liveness probe) fires
+#: first; the chaos suite passes a short override.
+HANG_DEFAULT_SEC = 3600.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One parsed ``--inject-fault`` value."""
+
+    kind: str
+    step: Optional[int] = None
+    hang_sec: Optional[float] = None
+
+    def __str__(self) -> str:
+        s = self.kind
+        if self.step is not None:
+            s += f"@{self.step}"
+        if self.hang_sec is not None:
+            s += f":{self.hang_sec:g}"
+        return s
+
+
+def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
+    """``"sigkill@10"`` -> FaultSpec; None/empty -> None; junk raises.
+
+    Grammar: ``KIND`` | ``KIND@STEP`` | ``hang@STEP:SECS``. Stepped kinds
+    *require* the step (a fault with no defined firing point would not be
+    reproducible); the save-path kinds refuse one (they fire on save
+    events, not steps).
+    """
+    if not spec:
+        return None
+    spec = spec.strip()
+    kind, _, rest = spec.partition("@")
+    if kind not in FAULT_KINDS:
+        raise ValueError(
+            f"unknown fault kind {kind!r} (expected one of "
+            f"{sorted(FAULT_KINDS)})"
+        )
+    if kind in STEPPED_KINDS:
+        if not rest:
+            raise ValueError(
+                f"fault {kind!r} needs an explicit step: {kind}@N "
+                "(a fault without a firing step is not reproducible)"
+            )
+        step_str, _, secs_str = rest.partition(":")
+        if secs_str and kind != "hang":
+            raise ValueError(
+                f"only 'hang' takes a duration suffix, got {spec!r}"
+            )
+        try:
+            step = int(step_str)
+        except ValueError:
+            raise ValueError(f"fault step must be an integer, got {spec!r}")
+        if step < 0:
+            raise ValueError(f"fault step must be >= 0, got {spec!r}")
+        hang_sec = None
+        if secs_str:
+            try:
+                hang_sec = float(secs_str)
+            except ValueError:
+                raise ValueError(
+                    f"hang duration must be a number, got {spec!r}"
+                )
+            if hang_sec <= 0:
+                raise ValueError(f"hang duration must be > 0, got {spec!r}")
+        return FaultSpec(kind=kind, step=step, hang_sec=hang_sec)
+    if rest:
+        raise ValueError(
+            f"fault {kind!r} fires on checkpoint saves and takes no @step "
+            f"(got {spec!r})"
+        )
+    return FaultSpec(kind=kind)
+
+
+def _tear_newest_file(step_dir: str) -> Optional[str]:
+    """Truncate the first (sorted) non-empty file under ``step_dir``.
+
+    Deterministic pick so the torn artifact is the same every run; returns
+    the torn path (repo of the chaos trail) or None when nothing tearable.
+    """
+    candidates = []
+    for dirpath, _dirnames, filenames in os.walk(step_dir):
+        for fn in sorted(filenames):
+            path = os.path.join(dirpath, fn)
+            try:
+                if os.path.getsize(path) > 0:
+                    candidates.append(path)
+            except OSError:
+                continue
+    if not candidates:
+        return None
+    victim = sorted(candidates)[0]
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.truncate(max(size // 2, 1) - 1 if size > 1 else 0)
+    return victim
+
+
+class FaultInjector:
+    """Arms one :class:`FaultSpec` against the train loop's boundaries.
+
+    Call sites (all at device-fenced points — the injector never adds a
+    sync of its own):
+
+    - :meth:`at_boundary` from ``sync_window`` after the window's
+      telemetry, with the window's last completed step;
+    - :meth:`corrupt_loss` on each step's freshly dispatched loss;
+    - :meth:`maybe_fail_save` just before a checkpoint save;
+    - :meth:`after_save` just after a committed checkpoint save.
+    """
+
+    def __init__(self, spec: Optional[FaultSpec] = None, recorder=None,
+                 is_main: bool = True):
+        self.spec = spec
+        self.recorder = recorder
+        self.is_main = is_main
+        self.fired = False
+
+    @property
+    def armed(self) -> bool:
+        return self.spec is not None
+
+    def _announce(self, detail: str) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder.note(
+                    "fault_injected", fault=str(self.spec), detail=detail,
+                )
+            except Exception:
+                pass
+        if self.is_main:
+            print(f"CHAOS: injecting fault {self.spec} — {detail}",
+                  flush=True)
+
+    # -- boundary faults ---------------------------------------------------
+
+    def at_boundary(self, last_step: int) -> None:
+        """Fire sigkill/sigterm/hang at the first boundary past the step."""
+        if (
+            self.spec is None or self.fired
+            or self.spec.kind not in ("sigkill", "sigterm", "hang")
+            or last_step < (self.spec.step or 0)
+        ):
+            return
+        self.fired = True
+        if self.spec.kind == "sigkill":
+            self._announce(f"SIGKILL at sync boundary, step {last_step}")
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif self.spec.kind == "sigterm":
+            self._announce(f"SIGTERM at sync boundary, step {last_step}")
+            os.kill(os.getpid(), signal.SIGTERM)
+        else:  # hang
+            secs = self.spec.hang_sec or HANG_DEFAULT_SEC
+            self._announce(
+                f"hang ({secs:g}s stall) at sync boundary, step {last_step}"
+            )
+            time.sleep(secs)
+
+    # -- loss corruption ---------------------------------------------------
+
+    def corrupt_loss(self, step: int, loss):
+        """NaN exactly step N's loss for ``nan-loss@N`` (else passthrough)."""
+        if (
+            self.spec is None or self.fired
+            or self.spec.kind != "nan-loss" or step != self.spec.step
+        ):
+            return loss
+        self.fired = True
+        self._announce(f"NaN loss injected at step {step}")
+        # Multiplying keeps shape/dtype/sharding; no host sync, no
+        # device fence — the NaN just rides the normal loss handle.
+        return loss * float("nan")
+
+    # -- save-path faults --------------------------------------------------
+
+    def maybe_fail_save(self) -> None:
+        """Raise ENOSPC from the save path for ``enospc-on-save``."""
+        if self.spec is None or self.spec.kind != "enospc-on-save":
+            return
+        self._announce("OSError(ENOSPC) raised from checkpoint save")
+        raise OSError(errno.ENOSPC, "No space left on device (injected)")
+
+    def after_save(self, ckpt, step: int) -> None:
+        """Tear the newest checkpoint + SIGKILL for ``torn-checkpoint``.
+
+        Waits until a committed *previous* step exists, so the resume has
+        a good step to fall back to — the whole point of the fault class.
+        """
+        if (
+            self.spec is None or self.fired
+            or self.spec.kind != "torn-checkpoint"
+        ):
+            return
+        steps = ckpt.all_steps()
+        if len(steps) < 2:
+            return
+        self.fired = True
+        victim = ckpt.step_dir(max(steps))
+        torn = _tear_newest_file(victim)
+        self._announce(
+            f"tore checkpoint step {max(steps)} ({torn}); SIGKILL"
+        )
+        os.kill(os.getpid(), signal.SIGKILL)

@@ -113,12 +113,21 @@ def gate_arm(
     baseline_sel: str = "last-good", candidate_sel: str = "latest",
     min_effect_pct: float = stats.DEFAULT_MIN_EFFECT_PCT,
     alpha: float = stats.DEFAULT_ALPHA,
+    bank_regressions: bool = True,
 ) -> Tuple[str, str]:
     """Gate one arm; returns (verdict, human line).
 
     A partial candidate never verdicts (its last-window rate is not a
-    run mean); a missing baseline is insufficient-data, not a failure —
-    the first-ever suite run on a fresh registry must pass the gate.
+    run mean); a resumed (stitched) candidate never verdicts either —
+    its first window folds in the restore recompile, so comparing it
+    would gate the recovery machinery, not the code. A missing baseline
+    is insufficient-data, not a failure — the first-ever suite run on a
+    fresh registry must pass the gate.
+
+    A REGRESSION verdict on the default last-good/latest path BANKS the
+    candidate (store.Registry.bank): the next run's "last known good"
+    skips the regressed record instead of adopting it, so one bad merge
+    cannot silently ratchet the baseline down (ROADMAP benchreg (b)).
     """
     cand = resolve_selector(reg, candidate_sel, arm)
     if cand.get("status") != "ok":
@@ -126,6 +135,11 @@ def gate_arm(
                 f"regress gate: SKIP arm={arm} candidate "
                 f"{cand.get('record_id')} has status="
                 f"{cand.get('status')!r} (partial runs never verdict)")
+    if (cand.get("result") or {}).get("resumed"):
+        return (stats.VERDICT_INSUFFICIENT,
+                f"regress gate: SKIP arm={arm} candidate "
+                f"{cand.get('record_id')} is a resumed (stitched) run — "
+                "not a clean measurement; rerun the arm for a verdict")
     if baseline_sel == "last-good":
         base = reg.baseline(
             arm, exclude_record_id=cand.get("record_id"),
@@ -151,6 +165,19 @@ def gate_arm(
         f"regress gate: {rep['verdict'].upper()} arm={arm} {c.summary()} "
         f"baseline={rep['baseline']} candidate={rep['candidate']}"
     )
+    if (
+        bank_regressions
+        and rep["verdict"] == stats.VERDICT_REGRESSION
+        and baseline_sel == "last-good" and candidate_sel == "latest"
+    ):
+        # Bank silently-idempotently; the bank note is its own (stable)
+        # line so the REGRESSION line format stays byte-pinned.
+        if reg.bank(cand.get("record_id"), reason=line):
+            line += (
+                f"\nregress gate: banked candidate {cand.get('record_id')} "
+                "as a known regression — future last-good lookups skip it "
+                "(`regress unbank` to lift)"
+            )
     return rep["verdict"], line
 
 
@@ -192,6 +219,7 @@ def trend_rows(
     recs = reg.records(arm)
     if limit:
         recs = recs[-limit:]
+    banked = reg.banked_ids()
     rows: List[Dict[str, Any]] = []
     prev_ok: Optional[float] = None
     best = max(
@@ -214,6 +242,8 @@ def trend_rows(
             "delta_pct_vs_prev": delta,
             "best": (rec.get("status") == "ok" and val is not None
                      and best is not None and val == best),
+            "banked": rec.get("record_id") in banked,
+            "resumed": bool((rec.get("result") or {}).get("resumed")),
         })
         if rec.get("status") == "ok" and val is not None:
             prev_ok = val
@@ -227,6 +257,8 @@ def format_trend(arm: str, rows: List[Dict[str, Any]]) -> str:
         delta = (f"{r['delta_pct_vs_prev']:+.2f}%"
                  if r["delta_pct_vs_prev"] is not None else "      ")
         flags = ("PARTIAL" if r["status"] != "ok"
+                 else "BANKED" if r.get("banked")
+                 else "RESUMED" if r.get("resumed")
                  else ("BEST" if r["best"] else ""))
         out.append(
             f"  {r['record_id']}  {val:>14} {r['metric_name'] or '':<24}"
@@ -322,6 +354,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     sub.add_parser("list", help="list arms and record counts")
 
+    pb = sub.add_parser(
+        "bank",
+        help="mark a record as a known regression (last-good skips it)",
+    )
+    pb.add_argument("record_id", help="record-id prefix")
+    pb.add_argument("--reason", default="operator-banked")
+
+    pu = sub.add_parser("unbank", help="lift a bank")
+    pu.add_argument("record_id", help="record-id prefix")
+    pu.add_argument("--reason", default="operator-unbanked")
+
     args = p.parse_args(argv)
 
     try:
@@ -391,10 +434,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1 if n_regressions else 0
 
         if args.cmd == "list":
+            banked = reg.banked_ids()
             for arm in reg.arms():
                 lines = [l for l in reg.index_lines() if l["arm"] == arm]
                 n_ok = sum(1 for l in lines if l["status"] == "ok")
-                print(f"{arm}: {len(lines)} record(s) ({n_ok} ok)")
+                n_banked = sum(1 for l in lines
+                               if l["record_id"] in banked)
+                extra = f", {n_banked} banked" if n_banked else ""
+                print(f"{arm}: {len(lines)} record(s) ({n_ok} ok{extra})")
+            return 0
+
+        if args.cmd in ("bank", "unbank"):
+            rec = reg.resolve(args.record_id)
+            if args.cmd == "bank":
+                changed = reg.bank(rec["record_id"], reason=args.reason)
+                verb = "banked" if changed else "already banked"
+            else:
+                changed = reg.unbank(rec["record_id"], reason=args.reason)
+                verb = "unbanked" if changed else "was not banked"
+            print(f"regress {args.cmd}: {rec['arm']} {rec['record_id']} "
+                  f"{verb}")
             return 0
     except store.SchemaDrift as e:
         print(f"regress: {e}", file=sys.stderr)

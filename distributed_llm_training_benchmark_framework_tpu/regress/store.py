@@ -35,6 +35,19 @@ Properties the gate relies on:
   with ``status: "partial"`` — visible in ``trend``, excluded from
   ``baseline()`` and from trend superlatives. A truncated run's
   last-window rate is not a run mean and must never anchor a verdict.
+- **Resumed runs join partials in the never-baseline set.** A stitched
+  run (``result.resumed`` true — chaos round, docs/FAULT_TOLERANCE.md)
+  is an honest *record* but a dishonest *baseline*: its first timed
+  window folds in the restore recompile and its step population spans
+  two attempts, so ``baseline()``/``history_values()`` skip it the same
+  way they skip partials.
+- **Known-regressed records are banked, not adopted.** When the gate
+  verdicts a regression, the candidate's record_id is appended to
+  ``banked.jsonl`` (append-only, bank/unbank action lines): "last known
+  good" then *skips* the banked record instead of adopting it as the
+  next baseline — without this, one accepted regression silently
+  ratchets the floor down for every later run. ``regress bank/unbank``
+  manage the set by hand.
 - **Schema drift refuses loudly.** Records and the registry meta carry
   ``schema_version``; a reader that encounters a NEWER version raises
   :class:`SchemaDrift` instead of guessing at fields it does not know —
@@ -62,6 +75,7 @@ REGISTRY_SCHEMA_VERSION = 1
 META_FILENAME = "registry_meta.json"
 INDEX_FILENAME = "index.jsonl"
 RECORDS_DIRNAME = "records"
+BANKED_FILENAME = "banked.jsonl"
 
 #: Statuses a record may carry. Only "ok" records are baseline-eligible.
 STATUSES = ("ok", "partial")
@@ -342,6 +356,69 @@ class Registry:
         if self._index_cache is not None:
             self._index_cache.append(index_line)
 
+    # -- banked regressions ------------------------------------------------
+
+    @property
+    def banked_path(self) -> str:
+        return os.path.join(self.root, BANKED_FILENAME)
+
+    def banked_ids(self) -> set:
+        """Record ids currently banked as known regressions.
+
+        ``banked.jsonl`` is append-only action lines ({record_id, action
+        bank|unbank, reason, at}); the effective set is the fold, so the
+        registry's everything-is-append-only invariant holds here too.
+        """
+        if not os.path.exists(self.banked_path):
+            return set()
+        banked: set = set()
+        with open(self.banked_path) as f:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    line = json.loads(raw)
+                except json.JSONDecodeError:
+                    # A torn append (process killed mid-write — the very
+                    # preemption this ledger serves) must not wedge every
+                    # gate/trend/baseline path; the lost action is at
+                    # worst one bank the next gate run re-banks.
+                    continue
+                if line.get("action", "bank") == "bank":
+                    banked.add(line["record_id"])
+                else:
+                    banked.discard(line["record_id"])
+        return banked
+
+    def bank(self, record_id: str, reason: str = "") -> bool:
+        """Mark a record as a known regression; returns True when new.
+
+        Banked records stay visible (trend, compare) but ``baseline()``
+        and ``history_values()`` skip them — "last known good" must skip
+        a banked regression instead of adopting it. Idempotent.
+        """
+        if record_id in self.banked_ids():
+            return False
+        with open(self.banked_path, "a") as f:
+            f.write(json.dumps({
+                "record_id": record_id, "action": "bank",
+                "reason": reason, "at": round(time.time(), 3),
+            }, sort_keys=True) + "\n")
+        return True
+
+    def unbank(self, record_id: str, reason: str = "") -> bool:
+        """Lift a bank (e.g. the regression was accepted as the new
+        normal and re-measured); returns True when it was banked."""
+        if record_id not in self.banked_ids():
+            return False
+        with open(self.banked_path, "a") as f:
+            f.write(json.dumps({
+                "record_id": record_id, "action": "unbank",
+                "reason": reason, "at": round(time.time(), 3),
+            }, sort_keys=True) + "\n")
+        return True
+
     # -- reads -------------------------------------------------------------
 
     def index_lines(self) -> List[Dict[str, Any]]:
@@ -408,11 +485,18 @@ class Registry:
         ``exclude_record_id`` keeps a candidate from being its own
         baseline; ``match_config_of`` restricts to records sharing the
         candidate's :func:`config_key` so a geometry change can never
-        masquerade as a perf delta.
+        masquerade as a perf delta. Banked regressions and resumed
+        (stitched) rows are skipped too — neither is a clean measurement
+        for anything to be judged against (module docstring).
         """
         want = config_key(match_config_of) if match_config_of else None
+        banked = self.banked_ids()
         for rec in reversed(self.records(arm)):
             if rec.get("status") != "ok":
+                continue
+            if rec.get("record_id") in banked:
+                continue
+            if (rec.get("result") or {}).get("resumed"):
                 continue
             if exclude_record_id and rec.get("record_id") == exclude_record_id:
                 continue
@@ -432,12 +516,19 @@ class Registry:
         :func:`config_key`: the noise floor must measure run-to-run
         jitter of ONE configuration, not the spread across historical
         config changes (a past legitimate improvement would otherwise
-        inflate the floor until it masked real regressions).
+        inflate the floor until it masked real regressions). Banked
+        regressions and resumed rows stay out for the same reason — a
+        stitched run's recompile-polluted value is not run-to-run jitter.
         """
         want = config_key(match_config_of) if match_config_of else None
+        banked = self.banked_ids()
         vals: List[float] = []
         for rec in reversed(self.records(arm)):
             if rec.get("status") != "ok":
+                continue
+            if rec.get("record_id") in banked:
+                continue
+            if (rec.get("result") or {}).get("resumed"):
                 continue
             if exclude_record_id and rec.get("record_id") == exclude_record_id:
                 continue

@@ -1,4 +1,4 @@
-"""Checkpoint / resume via orbax — sharding-aware save/restore.
+"""Checkpoint / resume via orbax — sharding-aware, self-validating save/restore.
 
 The reference has no checkpointing at all (SURVEY §5.4: nothing calls save;
 DeepSpeed's gather-on-save knob is dead config; fault tolerance is listed as
@@ -7,15 +7,55 @@ subsystem: orbax persists the param + optimizer-state pytrees *with their
 NamedShardings*, so a fully-sharded (fsdp/zero3) tier-B state saves and
 restores without ever materializing a replicated copy, and a resumed run
 continues the step count and LR schedule exactly.
+
+Chaos-harness hardening (docs/FAULT_TOLERANCE.md):
+
+- **Atomic sidecars.** Every metadata file this module writes (layout tag,
+  per-step digest, restart ledger) goes tmp + fsync + rename, so a crash
+  mid-write can never leave a truncated file that later reads misparse.
+- **Self-validating steps.** After a save commits, a ``digest_<step>.json``
+  sidecar records a sha256 over the step directory's payload. ``restore``
+  re-hashes before handing anything to orbax: a torn/corrupted step is
+  detected by *us*, loudly, instead of surfacing as an orbax traceback
+  deep in deserialization.
+- **Quarantine + fallback.** A step that fails validation is MOVED to
+  ``quarantine/step_<N>/`` (with a ``QUARANTINE.json`` note naming the
+  reason and the expected/actual digests) and restore falls back to the
+  previous committed step automatically. Nothing is deleted — the torn
+  artifact stays available for forensics.
+- **Restart ledger.** ``note_restart()`` counts resumes in
+  ``restarts.json`` so a stitched run can publish honest
+  ``resumed=true / n_restarts=K`` accounting (utils.metrics; the regress
+  registry refuses such rows as baselines).
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+
+#: Version of the digest-sidecar schema; readers skip (treat as legacy)
+#: anything newer rather than guess.
+DIGEST_SCHEMA_VERSION = 1
+
+QUARANTINE_DIRNAME = "quarantine"
+RESTARTS_FILENAME = "restarts.json"
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    """tmp + fsync + rename: either the old file or the complete new one."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 class BenchmarkCheckpointer:
@@ -44,23 +84,232 @@ class BenchmarkCheckpointer:
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         self.save_every = save_every
+        self.max_to_keep = max_to_keep
         self.layout = dict(layout or {"layer_layout": "contiguous"})
         os.makedirs(self.directory, exist_ok=True)
-        self.manager = ocp.CheckpointManager(
+        self.manager = self._make_manager()
+
+    def _make_manager(self):
+        return self._ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True
+            options=self._ocp.CheckpointManagerOptions(
+                max_to_keep=self.max_to_keep, create=True
             ),
         )
+
+    def _reset_manager(self) -> None:
+        """Rebuild the manager after the directory changed under it
+        (quarantine moves a step dir away; the manager caches its step
+        listing)."""
+        try:
+            self.manager.close()
+        except Exception:
+            pass
+        self.manager = self._make_manager()
 
     @property
     def _layout_path(self) -> str:
         return os.path.join(self.directory, "layout.json")
 
+    def step_dir(self, step: int) -> str:
+        """The on-disk directory of one committed step."""
+        return os.path.join(self.directory, str(step))
+
+    def _digest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"digest_{step}.json")
+
+    @property
+    def quarantine_dir(self) -> str:
+        return os.path.join(self.directory, QUARANTINE_DIRNAME)
+
+    @property
+    def _restarts_path(self) -> str:
+        return os.path.join(self.directory, RESTARTS_FILENAME)
+
     def should_save(self, step: int) -> bool:
         return self.save_every > 0 and step > 0 and step % self.save_every == 0
 
-    def save(self, step: int, params: Any, opt_state: Any, force: bool = False) -> bool:
+    # ------------------------------------------------------------------
+    # Digest sidecars (self-validation)
+    # ------------------------------------------------------------------
+
+    def compute_digest(self, step: int) -> Tuple[str, int]:
+        """sha256 over the step directory's payload; (digest, n_files).
+
+        Per-file content hashes keyed by relative path, combined in
+        sorted order — rename, truncation, bit-rot and missing files all
+        change it. Hashing costs one read of data the save just wrote;
+        against the price of resuming 100 steps from a silently corrupt
+        state it is cheap insurance.
+        """
+        root = self.step_dir(step)
+        entries: List[str] = []
+        n = 0
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fn in sorted(filenames):
+                path = os.path.join(dirpath, fn)
+                h = hashlib.sha256()
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+                entries.append(
+                    f"{os.path.relpath(path, root)}:{h.hexdigest()}"
+                )
+                n += 1
+        combined = hashlib.sha256(
+            "\n".join(sorted(entries)).encode()
+        ).hexdigest()
+        return combined, n
+
+    def _write_digest(
+        self, step: int, meta: Optional[Dict[str, Any]] = None
+    ) -> None:
+        digest, n_files = self.compute_digest(step)
+        _atomic_write_json(self._digest_path(step), {
+            "schema_version": DIGEST_SCHEMA_VERSION,
+            "step": step,
+            "algo": "sha256",
+            "digest": digest,
+            "n_files": n_files,
+            "meta": dict(meta or {}),
+        })
+
+    def _read_digest(self, step: int) -> Optional[Dict[str, Any]]:
+        path = self._digest_path(step)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (ValueError, OSError):
+            return {"unreadable": True}
+        ver = raw.get("schema_version")
+        if not isinstance(ver, int) or ver > DIGEST_SCHEMA_VERSION:
+            # A newer writer's sidecar: we cannot judge it — treat the
+            # step as legacy-valid rather than quarantine good data.
+            return None
+        return raw
+
+    def step_meta(self, step: int) -> Dict[str, Any]:
+        """The ``meta`` dict stored with the step's digest ({} if none).
+
+        Carries whatever the saver recorded at the boundary — the train
+        loop stores the last window loss, so a resumed run can publish
+        ``resume_baseline_loss`` and validate_results can check loss
+        continuity across the stitch.
+        """
+        raw = self._read_digest(step)
+        if not raw or raw.get("unreadable"):
+            return {}
+        meta = raw.get("meta")
+        return dict(meta) if isinstance(meta, dict) else {}
+
+    def validate_step(self, step: int) -> Tuple[str, str]:
+        """('ok'|'legacy'|'mismatch'|'unreadable'|'missing', detail).
+
+        'legacy' — no digest sidecar (pre-digest checkpoint, or a newer
+        sidecar schema): assumed valid, the same posture the layout tag
+        takes for pre-tag directories.
+        """
+        if not os.path.isdir(self.step_dir(step)):
+            return "missing", f"no step directory {self.step_dir(step)}"
+        raw = self._read_digest(step)
+        if raw is None:
+            return "legacy", "no digest sidecar (pre-digest checkpoint)"
+        if raw.get("unreadable"):
+            return "unreadable", f"digest sidecar {self._digest_path(step)} unparseable"
+        actual, _n = self.compute_digest(step)
+        if actual != raw.get("digest"):
+            return (
+                "mismatch",
+                f"expected {raw.get('digest')}, recomputed {actual}",
+            )
+        return "ok", "digest verified"
+
+    # ------------------------------------------------------------------
+    # Quarantine + fallback
+    # ------------------------------------------------------------------
+
+    def quarantine_step(self, step: int, reason: str) -> str:
+        """Move a failed step (+ its sidecar) under quarantine/; return path.
+
+        Nothing is deleted: the torn payload stays inspectable, and the
+        ``QUARANTINE.json`` note records why it was pulled. The orbax
+        manager is rebuilt so ``latest_step()`` stops offering the step.
+        """
+        os.makedirs(self.quarantine_dir, exist_ok=True)
+        dest = os.path.join(self.quarantine_dir, f"step_{step}")
+        suffix = 0
+        while os.path.exists(dest):
+            suffix += 1
+            dest = os.path.join(self.quarantine_dir, f"step_{step}.{suffix}")
+        os.makedirs(dest)
+        expected = self._read_digest(step) or {}
+        if os.path.isdir(self.step_dir(step)):
+            shutil.move(self.step_dir(step), os.path.join(dest, str(step)))
+        if os.path.exists(self._digest_path(step)):
+            shutil.move(
+                self._digest_path(step),
+                os.path.join(dest, os.path.basename(self._digest_path(step))),
+            )
+        _atomic_write_json(os.path.join(dest, "QUARANTINE.json"), {
+            "schema_version": DIGEST_SCHEMA_VERSION,
+            "step": step,
+            "reason": reason,
+            "expected_digest": expected.get("digest"),
+        })
+        self._reset_manager()
+        return dest
+
+    def latest_valid_step(self) -> Optional[int]:
+        """Newest step whose digest verifies, quarantining failures.
+
+        Walks committed steps newest-first; every torn/unreadable step is
+        quarantined (with the validation detail as the reason) and the
+        scan falls back — the automatic-recovery core the chaos suite's
+        torn-checkpoint arm exercises.
+        """
+        for step in sorted(self.all_steps(), reverse=True):
+            status, detail = self.validate_step(step)
+            if status in ("ok", "legacy"):
+                return step
+            dest = self.quarantine_step(step, f"{status}: {detail}")
+            print(
+                f"WARNING: checkpoint step {step} failed validation "
+                f"({status}: {detail}) — quarantined to {dest}, falling "
+                "back to the previous committed step"
+            )
+        return None
+
+    # ------------------------------------------------------------------
+    # Restart ledger (honest accounting)
+    # ------------------------------------------------------------------
+
+    def n_restarts(self) -> int:
+        try:
+            with open(self._restarts_path) as f:
+                return int(json.load(f).get("n_restarts", 0))
+        except (OSError, ValueError, TypeError):
+            return 0
+
+    def note_restart(self) -> int:
+        """Record one resume; returns the new total (1 = first resume)."""
+        n = self.n_restarts() + 1
+        _atomic_write_json(self._restarts_path, {"n_restarts": n})
+        return n
+
+    # ------------------------------------------------------------------
+    # Save / restore
+    # ------------------------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        params: Any,
+        opt_state: Any,
+        force: bool = False,
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> bool:
         # Check the directory's layout BEFORE persisting anything: a save
         # into a directory holding checkpoints of a DIFFERENT layout must
         # not write first and complain after — that would itself create the
@@ -112,12 +361,9 @@ class BenchmarkCheckpointer:
             # restore()'s) would then permanently misclassify, locking the
             # run out of its own directory. Stamp-then-crash-before-save
             # is the benign order (tag over an empty directory, loudly
-            # reclaimable above). Write-rename so a crash mid-write can't
+            # reclaimable above). Atomic write so a crash mid-write can't
             # leave a truncated tag.
-            tmp = self._layout_path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self.layout, f)
-            os.replace(tmp, self._layout_path)
+            _atomic_write_json(self._layout_path, self.layout)
         saved = self.manager.save(
             step,
             args=self._ocp.args.Composite(
@@ -128,7 +374,33 @@ class BenchmarkCheckpointer:
         )
         if saved:
             self.manager.wait_until_finished()
+            # Digest AFTER the commit barrier: the sidecar certifies
+            # committed bytes, so digest-present-and-valid == the step is
+            # restorable. A sidecar failure degrades to a legacy-valid
+            # step (warn), never to a failed benchmark.
+            try:
+                self._write_digest(step, meta=meta)
+            except OSError as e:
+                print(f"WARNING: checkpoint digest for step {step} not "
+                      f"written ({e}); step will restore as legacy-valid")
+            self._gc_digests()
         return bool(saved)
+
+    def _gc_digests(self) -> None:
+        """Drop sidecars for steps orbax's max_to_keep already removed."""
+        live = set(self.all_steps())
+        for path in list(os.listdir(self.directory)):
+            if not (path.startswith("digest_") and path.endswith(".json")):
+                continue
+            try:
+                step = int(path[len("digest_"):-len(".json")])
+            except ValueError:
+                continue
+            if step not in live:
+                try:
+                    os.remove(os.path.join(self.directory, path))
+                except OSError:
+                    pass
 
     def _read_layout(self) -> Optional[Dict[str, Any]]:
         """The directory's layout tag, normalized; None if absent."""
@@ -170,13 +442,47 @@ class BenchmarkCheckpointer:
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 
+    def all_steps(self) -> List[int]:
+        try:
+            return sorted(int(s) for s in self.manager.all_steps())
+        except Exception:
+            return []
+
     def restore(
         self, params_template: Any, opt_state_template: Any, step: Optional[int] = None
     ) -> Tuple[Any, Any, int]:
-        """Restore into the templates' shardings (abstract arrays accepted)."""
-        step = self.manager.latest_step() if step is None else step
+        """Restore into the templates' shardings (abstract arrays accepted).
+
+        With ``step=None`` the newest step whose digest VERIFIES is used —
+        torn/corrupt steps are quarantined and the restore falls back to
+        the previous committed step instead of surfacing an orbax
+        deserialization traceback. An explicitly requested step that fails
+        validation is quarantined and refused loudly (the caller pinned a
+        step; silently handing back a different one would be worse).
+        """
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+            step = self.latest_valid_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no valid checkpoints under {self.directory}"
+                )
+        else:
+            status, detail = self.validate_step(step)
+            if status == "missing":
+                # Never existed — nothing to quarantine (a fabricated
+                # quarantine entry with no payload would be forensic
+                # noise); just a wrong step number.
+                raise FileNotFoundError(
+                    f"no checkpoint step {step} under {self.directory} "
+                    f"(committed steps: {self.all_steps()})"
+                )
+            if status not in ("ok", "legacy"):
+                dest = self.quarantine_step(step, f"{status}: {detail}")
+                raise ValueError(
+                    f"checkpoint step {step} failed validation ({status}: "
+                    f"{detail}); quarantined to {dest}. Restore without an "
+                    "explicit step to fall back automatically."
+                )
         saved_layout = self._read_layout()
         if saved_layout is None:
             # Pre-tag checkpoints were always written in the contiguous
@@ -209,6 +515,25 @@ class BenchmarkCheckpointer:
             ),
         )
         return restored["params"], restored["opt_state"], step
+
+    def restore_latest(
+        self, params_template: Any, opt_state_template: Any
+    ) -> Optional[Tuple[Any, Any, int]]:
+        """Best-effort resume: newest VALID step, or None when none exists.
+
+        The train loop's ``--resume`` path: an empty directory (first
+        attempt of a retried arm) or an all-torn one degrades to a cold
+        start with a warning instead of a traceback — the retrying
+        orchestration must never be wedged by its own checkpoint dir.
+        Delegates to ``restore(step=None)`` so the payload is read and
+        hashed exactly once (a tier-B state is multi-GB, and this runs
+        inside the preemption-recovery grace window).
+        """
+        try:
+            return self.restore(params_template, opt_state_template,
+                                step=None)
+        except FileNotFoundError:
+            return None
 
     def close(self) -> None:
         self.manager.close()

@@ -206,6 +206,40 @@ class TelemetryRecorder:
         except (OSError, ValueError):
             pass  # telemetry must never fail the run
 
+    def note(self, event: str, **fields: Any) -> None:
+        """Emit one ad-hoc event into the JSONL stream.
+
+        The public hook for loop-adjacent machinery (fault injection,
+        checkpoint-save failures) that has something worth recording but
+        no schema claim of its own. Same best-effort semantics as every
+        other emit: a failed write never fails the run. Callers are
+        bound by the same cadence discipline as step_window — sync
+        boundaries only (graftcheck GC105).
+        """
+        self._emit(event, **fields)
+
+    def note_resume(
+        self, *, step: int, n_restarts: int, baseline_loss: Optional[float] = None,
+    ) -> None:
+        """Record that this run restored a checkpoint and continued.
+
+        Emits a ``resume`` event and folds ``resumed``/``n_restarts``
+        into the run-identity meta, so every subsequent heartbeat — and
+        the final ``run_end``/``run_aborted`` summary — carries the
+        stitch. A resumed run must never be mistakable for a clean
+        baseline anywhere downstream (regress registry, partial rows).
+        """
+        self.meta["resumed"] = True
+        self.meta["n_restarts"] = int(n_restarts)
+        self._emit(
+            "resume", step=step, n_restarts=int(n_restarts),
+            baseline_loss=(
+                round(baseline_loss, 6)
+                if baseline_loss is not None and math.isfinite(baseline_loss)
+                else None
+            ),
+        )
+
     # ------------------------------------------------------------------
     # Phases
     # ------------------------------------------------------------------
@@ -383,12 +417,44 @@ class TelemetryRecorder:
         # a block-buffered stdout would hold them hostage past a SIGKILL.
         print(f"{HEARTBEAT_MARKER} {json.dumps(payload)}", flush=True)
 
+    def emergency_heartbeat(
+        self, *, reason: str, extra: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Print one final heartbeat NOW, ignoring the cadence.
+
+        The preemption path's last word on stdout: carries ``reason``
+        (e.g. ``preempted``) plus whatever the emergency stop knows
+        (``emergency_checkpoint_step``), so collect_results.sh stamps the
+        salvaged partial row from the emergency checkpoint's metadata
+        rather than an older cadenced heartbeat.
+        """
+        if not (self.enabled and self.is_main):
+            return
+        self._last_hb_t = time.perf_counter()
+        loss = self._last_loss
+        payload = {
+            "arm": self.arm,
+            "step": self._last_step,
+            "total_steps": self.total_steps,
+            "loss": (round(loss, 4)
+                     if loss is not None and math.isfinite(loss) else None),
+            "tokens_per_sec": round(
+                self._cum_tokens / self._cum_window_sec
+                if self._cum_window_sec > 0 else 0.0, 1),
+            "phase": self._phase,
+            "reason": reason,
+            "ts": round(time.time(), 3),
+        }
+        payload.update(self.meta)
+        payload.update(extra or {})
+        print(f"{HEARTBEAT_MARKER} {json.dumps(payload)}", flush=True)
+
     # ------------------------------------------------------------------
     # Shutdown
     # ------------------------------------------------------------------
 
     def _summary_fields(self) -> Dict[str, Any]:
-        return {
+        fields = {
             "last_step": self._last_step,
             "phase": self._phase,
             "phase_times": {k: round(v, 6)
@@ -397,6 +463,34 @@ class TelemetryRecorder:
             "n_anomalies": self._n_anomalies,
             "n_unresolved_anomalies": self.n_unresolved_anomalies,
         }
+        if self.meta.get("resumed"):
+            # Stitched runs carry their accounting into the terminal
+            # event too, so a JSONL alone (no result row) still shows
+            # the run was not a clean single-attempt measurement.
+            fields["resumed"] = True
+            fields["n_restarts"] = self.meta.get("n_restarts", 1)
+        return fields
+
+    def discard(self) -> None:
+        """Close WITHOUT a terminal event and delete the JSONL. Idempotent.
+
+        For refusal paths that must leave no trail: opening the recorder
+        truncated ``telemetry_<arm>.jsonl``, so a refused re-invocation
+        (e.g. a resume with nothing left to run) would otherwise replace
+        a completed run's telemetry with a ``run_aborted`` stub — and the
+        validator would then reject the completed run's published row as
+        "crashed". Only sane before any step windows were recorded.
+        """
+        if self._closed:
+            return
+        self._closed = True
+        path = self.path
+        self._teardown()
+        if path is not None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
     def abort(self, reason: str) -> None:
         """Emit ``run_aborted`` and release the hooks. Idempotent."""

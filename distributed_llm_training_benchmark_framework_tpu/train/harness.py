@@ -198,6 +198,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Resume from the latest checkpoint in --checkpoint-dir")
     p.add_argument("--debug", action="store_true",
                    help="Fail-fast numerics: NaN checks, tracer-leak checks")
+    # Chaos harness (faults/, docs/FAULT_TOLERANCE.md): deterministic
+    # fault injection for recovery proofs; INJECT_FAULT env is the
+    # flagless fallback.
+    p.add_argument("--inject-fault", type=str, default=None,
+                   help="Arm one deterministic chaos fault: sigkill@N, "
+                        "sigterm@N, nan-loss@N, hang@N[:SECS], "
+                        "torn-checkpoint, enospc-on-save — each fires at "
+                        "an exact sync-window boundary so chaos runs are "
+                        "reproducible (scripts/chaos_suite.sh drives the "
+                        "matrix)")
     return p
 
 
@@ -285,6 +295,13 @@ def main(argv=None) -> int:
         num_processes=args.num_processes,
         process_id=args.rank if args.num_processes else None,
     )
+    from ..faults import (
+        EXIT_NOTHING_TO_RESUME,
+        EXIT_PREEMPTED,
+        NothingToResume,
+        Preempted,
+    )
+
     try:
         from .loop import run_benchmark
 
@@ -332,7 +349,20 @@ def main(argv=None) -> int:
             resume=args.resume,
             telemetry=args.telemetry == "on",
             heartbeat_sec=args.heartbeat_sec,
+            inject_fault=args.inject_fault,
         )
+    except Preempted as e:
+        # Distinct exit code: the retrying orchestration (with_retries.sh,
+        # docker/entrypoint.sh) keys resume-instead-of-cold-restart on it.
+        print(f"PREEMPTED: {e} — exiting {EXIT_PREEMPTED} "
+              "(resume with --resume)", flush=True)
+        return EXIT_PREEMPTED
+    except NothingToResume as e:
+        # Deterministic refusal — its own code so retry wrappers stop
+        # instead of burning their backoff budget on identical attempts.
+        print(f"NOTHING TO RESUME: {e} — exiting {EXIT_NOTHING_TO_RESUME}",
+              flush=True)
+        return EXIT_NOTHING_TO_RESUME
     finally:
         dist.cleanup_distributed()
     return 0

@@ -21,10 +21,20 @@ boundary, phase-time attribution (init/compile/warmup/timed/checkpoint/
 trace/finalize) into the result row, and a ``run_aborted`` event on any
 crash. All recorder call sites sit at sync boundaries (graftcheck rule
 GC105 pins this), so telemetry never adds a device sync to a timed window.
+
+Chaos harness (docs/FAULT_TOLERANCE.md): the loop is preemption-safe — a
+SIGTERM sets a flag (``faults.PreemptionGuard``, installed OUTSIDE the
+timed loop per graftcheck GC106) that the loop polls at sync-window
+boundaries; on preemption it emergency-checkpoints, emits ``run_aborted
+reason=preempted`` plus a final heartbeat, and exits with the distinct
+``EXIT_PREEMPTED`` code the retrying orchestration resumes on. The same
+boundaries host the deterministic fault injector (``--inject-fault`` /
+``INJECT_FAULT``) the chaos suite uses to prove all of this works.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -33,6 +43,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data import SyntheticDataset
+from ..faults import (
+    FaultInjector,
+    NothingToResume,
+    Preempted,
+    PreemptionGuard,
+    parse_fault_spec,
+)
 from ..models import get_model_config
 from ..parallel import make_mesh, StrategyConfig
 from ..runtime import distributed as dist
@@ -134,9 +151,17 @@ def run_benchmark(*, prng_impl: str = "rbg", **kwargs) -> metrics_mod.BenchmarkR
     See ``_run_benchmark_impl`` for the full parameter list.
     """
     recorder = _make_recorder(kwargs)
+    # SIGTERM guard installed here — before any device work, outside the
+    # timed loop (graftcheck GC106) — so even a preemption landing during
+    # init/compile is caught at the first boundary poll; the finally
+    # restores the previous handler for embedding callers (bench.py runs
+    # several arms in one process).
+    guard = PreemptionGuard()
     try:
         if not prng_impl:
-            return _run_benchmark_impl(recorder=recorder, **kwargs)
+            return _run_benchmark_impl(
+                recorder=recorder, preempt_guard=guard, **kwargs
+            )
         prev_impl = jax.config.jax_default_prng_impl
         try:
             jax.config.update("jax_default_prng_impl", prng_impl)
@@ -148,12 +173,18 @@ def run_benchmark(*, prng_impl: str = "rbg", **kwargs) -> metrics_mod.BenchmarkR
                 raise
             jax.config.update("jax_default_prng_impl", alias)
         try:
-            return _run_benchmark_impl(recorder=recorder, **kwargs)
+            return _run_benchmark_impl(
+                recorder=recorder, preempt_guard=guard, **kwargs
+            )
         finally:
             jax.config.update("jax_default_prng_impl", prev_impl)
     except BaseException as e:
+        # Idempotent: the preemption path already aborted with
+        # reason=preempted; any other escape records its exception here.
         recorder.abort(f"exception:{type(e).__name__}: {e}")
         raise
+    finally:
+        guard.uninstall()
 
 
 def _run_benchmark_impl(
@@ -197,14 +228,19 @@ def _run_benchmark_impl(
     resume: bool = False,
     telemetry: bool = True,
     heartbeat_sec: float = 30.0,
+    inject_fault: Optional[str] = None,
     recorder: Optional[TelemetryRecorder] = None,
+    preempt_guard: Optional[PreemptionGuard] = None,
 ) -> metrics_mod.BenchmarkResult:
     """Benchmark body (see run_benchmark).
 
     ``telemetry``/``heartbeat_sec`` configure the flight recorder (already
     consumed by ``_make_recorder`` when entering via run_benchmark);
     ``recorder`` is injected by the wrapper so the crash guard outlives
-    this frame.
+    this frame, and ``preempt_guard`` so the SIGTERM handler is installed
+    before (and survives past) this frame. ``inject_fault`` arms one
+    deterministic chaos fault (faults.parse_fault_spec grammar; the
+    ``INJECT_FAULT`` env var is the flagless fallback).
     """
     if recorder is None:
         # Direct-impl callers (tests) still get phase accounting.
@@ -213,6 +249,14 @@ def _run_benchmark_impl(
         )
         recorder.begin_phase("init")
     is_main = dist.is_main_process() and rank == 0
+    preempt = preempt_guard or PreemptionGuard(enabled=False)
+    chaos = FaultInjector(
+        parse_fault_spec(
+            inject_fault if inject_fault is not None
+            else os.environ.get("INJECT_FAULT")
+        ),
+        recorder=recorder, is_main=is_main,
+    )
     devices = jax.devices()
     if world_size > len(devices):
         raise ValueError(
@@ -491,6 +535,9 @@ def _run_benchmark_impl(
 
     ckpt = None
     start_step = 0
+    n_restarts = 0
+    resume_step = -1
+    resume_baseline_loss = 0.0
     if checkpoint_dir:
         from ..runtime.checkpoint import BenchmarkCheckpointer
 
@@ -507,11 +554,53 @@ def _run_benchmark_impl(
                 ),
             },
         )
-        if resume and ckpt.latest_step() is not None:
-            params, opt_state, start_step = ckpt.restore(params, opt_state)
-            start_step += 1
-            if is_main:
-                print(f"Resumed from checkpoint at step {start_step - 1}")
+        if resume:
+            # restore_latest validates digests newest-first, quarantining
+            # torn steps and falling back — a corrupted tail never
+            # surfaces as an orbax traceback, and an empty/all-torn
+            # directory degrades to a cold start (the retrying
+            # orchestration passes --resume unconditionally on retries).
+            restored = ckpt.restore_latest(params, opt_state)
+            if restored is not None:
+                params, opt_state, resume_step = restored
+                start_step = resume_step + 1
+                if start_step >= steps:
+                    # Nothing left to run: a "resumed" row here would have
+                    # ZERO timed steps and publish 0 tokens/sec over the
+                    # real result (observed when a retry loop re-resumes a
+                    # run whose final step already checkpointed). Refuse —
+                    # the orchestration's salvage path (heartbeat partial)
+                    # is the honest record of the dead attempt. The
+                    # dedicated exception maps to EXIT_NOTHING_TO_RESUME
+                    # (76) in the harness, which the retry wrappers treat
+                    # as terminal: the refusal is deterministic. The
+                    # recorder already truncated telemetry_<arm>.jsonl at
+                    # construction — discard it, or the refusal's
+                    # run_aborted trail would sit beside the completed
+                    # run's published row and make validate_results
+                    # reject a perfectly good result.
+                    recorder.discard()
+                    raise NothingToResume(
+                        f"--resume found checkpoint step {resume_step} but "
+                        f"--steps {steps} leaves no steps to run: the run "
+                        "already completed (or the checkpoint belongs to a "
+                        "longer configuration). Nothing to measure — not "
+                        "publishing a zero-step row."
+                    )
+                n_restarts = ckpt.note_restart()
+                resume_baseline_loss = float(
+                    ckpt.step_meta(resume_step).get("last_loss") or 0.0
+                )
+                recorder.note_resume(
+                    step=resume_step, n_restarts=n_restarts,
+                    baseline_loss=resume_baseline_loss or None,
+                )
+                if is_main:
+                    print(f"Resumed from checkpoint at step {resume_step} "
+                          f"(restart #{n_restarts})")
+            elif is_main:
+                print("Resume requested but no valid checkpoint found — "
+                      "cold start")
 
     # Timing discipline. Steps are data-dependent (params chain through the
     # jitted step), so the device necessarily executes them back-to-back;
@@ -523,6 +612,7 @@ def _run_benchmark_impl(
     # but N>1 keeps host round-trip latency (dispatch + sync RPCs) out of
     # the hot loop, which matters when the host link is slow.
     pending: list = []  # (step, loss_handle) since last sync
+    last_loss_box = [None]  # last synced loss — emergency-checkpoint meta
 
     def sync_window(t_start):
         """Block on the window's last loss; distribute wall time evenly.
@@ -530,12 +620,15 @@ def _run_benchmark_impl(
         Also the telemetry boundary: with the device already fenced, the
         recorder logs the window (step/loss/mean time/HBM sample) and may
         print a heartbeat — the only sanctioned place for telemetry IO in
-        the loop (graftcheck GC105).
+        the loop (graftcheck GC105). The chaos injector's boundary hook
+        fires here too, AFTER the window's telemetry committed: a fault's
+        trail always records the window it killed.
         """
         if not pending:
             return
         jax.block_until_ready(pending[-1][1])
         dt = (time.perf_counter() - t_start) / len(pending)
+        last = pending[-1][0]
         window_losses = []
         for s, l in pending:
             lf = float(l)
@@ -546,10 +639,63 @@ def _run_benchmark_impl(
             if is_main and s % log_every == 0:
                 print(f"[Step {s:04d}] Loss: {lf:.4f}, Time: {dt:.3f}s")
         recorder.step_window(
-            last_step=pending[-1][0], losses=window_losses,
+            last_step=last, losses=window_losses,
             window_mean_step_time_sec=dt,
         )
+        last_loss_box[0] = window_losses[-1]
         pending.clear()
+        chaos.at_boundary(last)
+
+    def _emergency_stop(at_step):
+        """SIGTERM landed: checkpoint at this fenced boundary and stop.
+
+        Called only where the device is already fenced and ``pending``
+        is empty, so params/opt_state are exactly the post-``at_step``
+        state. Saves (when a checkpointer exists and at least one new
+        step ran), prints the final heartbeat carrying the emergency
+        checkpoint's metadata, emits ``run_aborted reason=preempted``,
+        and raises Preempted — the harness maps it to EXIT_PREEMPTED.
+        """
+        saved = None
+        if ckpt is not None and at_step >= max(start_step, 0):
+            if ckpt.latest_step() == at_step:
+                # The periodic save already committed this exact boundary
+                # (orbax refuses same-step overwrites even with force) —
+                # the state is durable, which is all the resume needs.
+                saved = at_step
+            else:
+                recorder.begin_phase("checkpoint")
+                try:
+                    ckpt.save(
+                        at_step, params, opt_state, force=True,
+                        meta={"last_loss": last_loss_box[0],
+                              "emergency": True, "reason": "preempted"},
+                    )
+                    saved = at_step
+                    if is_main:
+                        print(f"Emergency checkpoint saved at step {at_step} "
+                              "(preempted)")
+                except Exception as e:
+                    # Broadest net of any save site: whatever went wrong,
+                    # the run must still abort AS PREEMPTED (clean trail,
+                    # exit 75) rather than degrade to a generic crash.
+                    recorder.note("checkpoint_failed", step=at_step,
+                                  error=str(e), emergency=True)
+                    if is_main:
+                        print(f"WARNING: emergency checkpoint at step "
+                              f"{at_step} failed ({e}); aborting as a "
+                              "plain partial")
+        recorder.emergency_heartbeat(
+            reason="preempted",
+            extra={"emergency_checkpoint_step": saved},
+        )
+        recorder.abort("preempted")
+        raise Preempted(at_step, saved)
+
+    if preempt.requested:
+        # Preempted before the first dispatch (init/compile): nothing new
+        # to save, but the abort trail still records the clean reason.
+        _emergency_stop(start_step - 1)
 
     recorder.begin_phase("compile")
     t_window = time.perf_counter()
@@ -595,6 +741,7 @@ def _run_benchmark_impl(
                 print(f"[Step {step:04d}] delayed-update phase begins")
             t_window = time.perf_counter()
         params, opt_state, loss = active_state.step_fn(params, opt_state, table, step)
+        loss = chaos.corrupt_loss(step, loss)
         pending.append((step, loss))
         if step == start_step and step < warmup_steps:
             # Fence the first dispatched step on its own: its wall time is
@@ -630,19 +777,61 @@ def _run_benchmark_impl(
         ):
             sync_window(t_window)
             recorder.begin_phase("checkpoint")
-            ckpt.save(step, params, opt_state)
-            if is_main:
-                print(f"Checkpoint saved at step {step}")
+            try:
+                chaos.maybe_fail_save()
+                ckpt.save(step, params, opt_state,
+                          meta={"last_loss": last_loss_box[0]})
+                if is_main:
+                    print(f"Checkpoint saved at step {step}")
+                chaos.after_save(ckpt, step)
+            except OSError as e:
+                # A full disk (ENOSPC et al.) must degrade the checkpoint
+                # cadence, never kill the benchmark: the run finishes on
+                # its older checkpoints, and the telemetry trail says why
+                # the cadence has a hole.
+                recorder.note("checkpoint_failed", step=step, error=str(e))
+                if is_main:
+                    print(f"WARNING: checkpoint save at step {step} failed "
+                          f"({e}); continuing without")
             recorder.begin_phase("timed" if step >= warmup_steps else "warmup")
             t_window = time.perf_counter()
+        # Preemption poll — last statement of the body, so a SIGTERM that
+        # arrived any time this iteration is acted on at the freshest
+        # fenced boundary (and never mid-window: pending must be empty).
+        # The FINAL iteration is exempt: every step has executed by then,
+        # so aborting would trade a complete measurement for a resume
+        # that deterministically refuses — the post-loop branch publishes
+        # instead.
+        if preempt.requested and not pending and step < steps - 1:
+            _emergency_stop(step)
 
     sync_window(t_window)
+    if preempt.requested and is_main:
+        # SIGTERM during the final window: every step already executed
+        # and synced, so aborting would promise a resume that has NOTHING
+        # left to run (the retry would refuse deterministically). The
+        # honest reaction is to PUBLISH: the remaining finalize tail is
+        # seconds against a grace window sized in minutes, and a kill
+        # landing mid-finalize still leaves the normal crash trail plus
+        # the final checkpoint committed below.
+        print("NOTE: preemption requested during the final window; all "
+              "steps completed — publishing the result before exiting")
     if ckpt is not None:
         recorder.begin_phase("checkpoint")
-        # Final save only if this run actually executed steps — a resume that
-        # had nothing left to do must not relabel later-step state.
-        if start_step < steps:
-            ckpt.save(steps - 1, params, opt_state, force=True)
+        # Final save only if this run actually executed steps — and only
+        # when the final step is not ALREADY committed (a checkpoint
+        # cadence dividing steps-1 lands the periodic save there first;
+        # orbax refuses same-step overwrites even with force=True).
+        if start_step < steps and ckpt.latest_step() != steps - 1:
+            try:
+                chaos.maybe_fail_save()
+                ckpt.save(steps - 1, params, opt_state, force=True,
+                          meta={"last_loss": last_loss_box[0]})
+            except OSError as e:
+                recorder.note("checkpoint_failed", step=steps - 1,
+                              error=str(e))
+                if is_main:
+                    print(f"WARNING: final checkpoint save failed ({e})")
         ckpt.close()
     if trace_started:
         # stop_trace serializes the Chrome trace to disk — seconds for a
@@ -751,7 +940,10 @@ def _run_benchmark_impl(
         ),
         expert_overflow_pct=expert_overflow_pct,
         model_family=model_family,
-        resumed=start_step > 0,
+        resumed=resume_step >= 0,
+        n_restarts=n_restarts,
+        resume_step=resume_step,
+        resume_baseline_loss=resume_baseline_loss,
         prior_peak_bytes=prior_peak_bytes,
         wall_time_total_sec=recorder.wall_time_total(),
         phase_times=recorder.phase_times(),

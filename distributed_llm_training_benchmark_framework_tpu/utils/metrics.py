@@ -301,6 +301,17 @@ class BenchmarkResult:
     # loss starts wherever the checkpoint left off, so the from-scratch
     # descent envelope does not apply.
     resumed: bool = False
+    # Honest stitched-run accounting (chaos round, docs/FAULT_TOLERANCE.md):
+    # how many times this arm resumed (the checkpoint dir's restart
+    # ledger), which step it restored, and the loss recorded at that
+    # checkpoint's save boundary. validate_results checks pre/post loss
+    # continuity across the stitch, and the regress registry refuses
+    # resumed rows as baselines — a stitched run must never pollute the
+    # noise floor or pose as a clean measurement. All defaults for
+    # non-resumed runs and pre-chaos artifacts.
+    n_restarts: int = 0
+    resume_step: int = -1
+    resume_baseline_loss: float = 0.0
     # --- flight-recorder phase attribution (telemetry.TelemetryRecorder,
     # round 8) — where the run's wall time actually went. Measured from
     # recorder start to result computation; the run's telemetry JSONL
@@ -368,6 +379,9 @@ def compute_result(
     expert_overflow_pct: Optional[float] = None,
     model_family: str = "tinygpt",
     resumed: bool = False,
+    n_restarts: int = 0,
+    resume_step: int = -1,
+    resume_baseline_loss: float = 0.0,
     prior_peak_bytes: Optional[int] = None,
     wall_time_total_sec: float = 0.0,
     phase_times: Optional[Dict[str, float]] = None,
@@ -474,6 +488,9 @@ def compute_result(
         loss_last_window=loss_last,
         loss_window_steps=lw,
         resumed=resumed,
+        n_restarts=n_restarts,
+        resume_step=resume_step,
+        resume_baseline_loss=round(resume_baseline_loss, 6),
         wall_time_total_sec=round(wall_time_total_sec, 4),
         time_in_init_sec=round(pt.get("init", 0.0), 4),
         time_in_compile_sec=round(pt.get("compile", 0.0), 4),
@@ -530,6 +547,12 @@ def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True)
         )
     if result.n_anomalies > 0:
         print(f"  ANOMALIES:        {result.n_anomalies} (see telemetry JSONL)")
+    if result.resumed:
+        print(
+            f"  RESUMED:          from step {result.resume_step} "
+            f"(restart #{result.n_restarts}) — stitched run, never a "
+            "regression baseline"
+        )
     print("=" * 80 + "\n")
 
     os.makedirs(results_dir, exist_ok=True)

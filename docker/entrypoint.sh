@@ -82,6 +82,17 @@ export CHECKPOINT_DIR="${CHECKPOINT_DIR:-}"
 export CHECKPOINT_EVERY="${CHECKPOINT_EVERY:-}"
 export RESUME="${RESUME:-0}"
 export DEBUG="${DEBUG:-0}"
+# Chaos harness (faults/, docs/FAULT_TOLERANCE.md): arm one deterministic
+# fault (sigkill@N / sigterm@N / nan-loss@N / hang@N / torn-checkpoint /
+# enospc-on-save) — chaos pods prove the recovery path on real slices.
+export INJECT_FAULT="${INJECT_FAULT:-}"
+# In-pod retry loop: 0 (default) keeps the exec'd single-attempt path
+# (python as PID 1 — the preStop/terminationGrace SIGTERM contract).
+# N > 0 supervises the harness from bash, forwarding SIGTERM, and
+# retries a failed run up to N times with RETRY_BACKOFF_SEC backoff —
+# resuming from CHECKPOINT_DIR when one is configured.
+export MAX_ARM_RETRIES="${MAX_ARM_RETRIES:-0}"
+export RETRY_BACKOFF_SEC="${RETRY_BACKOFF_SEC:-5}"
 # Flight-recorder telemetry (docs/OBSERVABILITY.md): on by default — the
 # heartbeat markers are what scripts/collect_results.sh scrapes into a
 # partial_<arm>.json when a pod dies before the final result marker.
@@ -171,6 +182,8 @@ if [ "${FLASH_BLOCKWISE_BACKWARD}" = "1" ]; then
   ARGS="${ARGS} --flash-blockwise-backward"; fi
 if [ "${RESUME}" = "1" ]; then ARGS="${ARGS} --resume"; fi
 if [ "${DEBUG}" = "1" ]; then ARGS="${ARGS} --debug"; fi
+if [ -n "${INJECT_FAULT}" ]; then
+  ARGS="${ARGS} --inject-fault ${INJECT_FAULT}"; fi
 
 # GRAFTCHECK=1: run the static preflight (collective-budget audit + lint,
 # scripts/graftcheck.sh) before launching. Runs on the container's host CPU
@@ -196,4 +209,58 @@ echo ""
 # stdout stream stays untouched (interposing a tee on PID 1's stdout
 # risks losing the final result markers in the teardown race), and exec
 # keeps python as PID 1.
-exec python -u /app/benchmarking/train_harness.py ${ARGS}
+if [ "${MAX_ARM_RETRIES}" = "0" ]; then
+  exec python -u /app/benchmarking/train_harness.py ${ARGS}
+fi
+
+# Retry mode: bash stays PID 1, so kubelet's SIGTERM lands HERE — forward
+# it to the harness child or the preemption handler (train/loop.py) never
+# runs and the grace period is wasted. `wait` returns >128 when the trap
+# fires, so re-wait until the child actually exits.
+run_once() {
+  python -u /app/benchmarking/train_harness.py $1 &
+  local child=$!
+  trap 'kill -TERM "$child" 2>/dev/null' TERM
+  local rc=0
+  while :; do
+    wait "$child"; rc=$?
+    kill -0 "$child" 2>/dev/null || break
+  done
+  trap - TERM
+  return "$rc"
+}
+
+# Snapshot the fault spec ONCE: retries strip it from the rebuilt args
+# and clear the env fallback on EVERY attempt > 1 (keying the strip on
+# the live $INJECT_FAULT would stop stripping after attempt 2 cleared
+# it, and the fault would re-arm from the pristine $ARGS on attempt 3).
+FAULT_SPEC="${INJECT_FAULT}"
+attempt=0
+while :; do
+  attempt=$((attempt + 1))
+  RETRY_ARGS="$ARGS"
+  if [ "$attempt" -gt 1 ]; then
+    # Resume, don't cold-restart (when a checkpoint dir exists), and
+    # never re-fire an injected chaos fault on its own recovery attempt.
+    if [ -n "${CHECKPOINT_DIR}" ] && [[ "$RETRY_ARGS" != *" --resume"* ]]; then
+      RETRY_ARGS="$RETRY_ARGS --resume"
+    fi
+    if [ -n "${FAULT_SPEC}" ]; then
+      RETRY_ARGS="${RETRY_ARGS/ --inject-fault ${FAULT_SPEC}/}"
+      export INJECT_FAULT=""
+    fi
+  fi
+  run_once "$RETRY_ARGS"
+  rc=$?
+  [ "$rc" -eq 0 ] && exit 0
+  # 76 = nothing-to-resume (faults.EXIT_NOTHING_TO_RESUME): the refusal
+  # is deterministic — retrying burns the backoff budget for nothing.
+  if [ "$rc" -eq 76 ] || [ "$attempt" -gt "${MAX_ARM_RETRIES}" ]; then
+    exit "$rc"
+  fi
+  backoff=$((RETRY_BACKOFF_SEC * (1 << (attempt - 1))))
+  kind="exit=$rc"
+  [ "$rc" -eq 75 ] && kind="preempted (exit=75)"
+  echo "entrypoint: attempt $attempt failed [$kind]; retrying in ${backoff}s"
+  sleep "$backoff"
+done

@@ -1,0 +1,208 @@
+#!/usr/bin/env bash
+# Chaos suite: drive the fault-injection matrix end to end and assert that
+# EVERY fault class lands in one of the two honest outcomes
+# (docs/FAULT_TOLERANCE.md):
+#
+#   - a completed, validated result (after resume where the class allows
+#     recovery): sigkill, sigterm, torn-checkpoint, enospc-on-save;
+#   - a correctly classified failure: nan-loss completes but
+#     validate_results REJECTS the row (unresolved anomaly); hang is
+#     killed by the timeout and salvages into a partial_<arm>.json.
+#
+# Faults fire at exact sync-window boundaries (faults/injection.py), so
+# the whole suite is reproducible: same spec, same abort step, every run.
+#
+#   chaos_suite.sh                 # full matrix on the tinygpt smoke config
+#   chaos_suite.sh --smoke         # 2-fault smoke (sigkill + torn-checkpoint)
+#   chaos_suite.sh --faults "sigterm hang" --results-dir /tmp/chaos
+#
+# Runs on the host CPU by default (the recovery logic is host-level; no
+# slice time is worth burning on it) — set CHAOS_ON_DEVICE=1 to inherit
+# the caller's JAX platform instead.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+REPO_ROOT="$(pwd)"
+
+FAULTS="sigkill sigterm nan-loss hang torn-checkpoint enospc-on-save"
+ROOT=""
+KEEP=0
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --smoke) FAULTS="sigkill torn-checkpoint"; shift ;;
+    --faults) FAULTS="$2"; shift 2 ;;
+    --results-dir) ROOT="$2"; shift 2 ;;
+    --keep) KEEP=1; shift ;;
+    *) echo "chaos_suite: unknown flag $1" >&2; exit 2 ;;
+  esac
+done
+if [ -z "$ROOT" ]; then
+  ROOT="$(mktemp -d /tmp/chaos_suite.XXXXXX)"
+else
+  mkdir -p "$ROOT"
+fi
+
+if [ "${CHAOS_ON_DEVICE:-0}" != "1" ]; then
+  export JAX_PLATFORMS=cpu
+  case "${XLA_FLAGS:-}" in
+    *xla_force_host_platform_device_count*) : ;;
+    *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" ;;
+  esac
+fi
+
+# The tinygpt smoke config: small enough that the whole matrix is minutes
+# on a laptop CPU, checkpoint cadence dense enough that every recovery
+# fault has a committed step behind it. Faults are pinned mid-timed-loop
+# (warmup 2, inject at 8/9) so the recovery proof covers the measured
+# region, not just warmup.
+STEPS=14; WARMUP=2; CKPT_EVERY=4
+# sync-every 2: windowed timing, same discipline as the real suite — a
+# tiny CPU smoke's per-step jitter would otherwise trip the validator's
+# CV envelope and masquerade as a chaos failure.
+HARNESS=(python -u benchmarking/train_harness.py
+         --strategy ddp --world-size 1 --rank 0 --tier S --seq-len 32
+         --steps "$STEPS" --warmup-steps "$WARMUP" --per-device-batch 1
+         --grad-accum 1 --dataset-size 64 --heartbeat-sec 0 --sync-every 2)
+
+PASS=0; FAIL=0
+declare -a SUMMARY
+
+fail() { echo "CHAOS FAIL $1: $2" >&2; FAIL=$((FAIL+1)); SUMMARY+=("FAIL $1: $2"); }
+ok()   { echo "CHAOS OK   $1: $2"; PASS=$((PASS+1)); SUMMARY+=("ok   $1: $2"); }
+
+run_arm() {  # run_arm <dir> <log> [extra flags...]
+  local dir="$1" log="$2"; shift 2
+  "${HARNESS[@]}" --results-dir "$dir/results" \
+    --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+    "$@" > "$log" 2>&1
+}
+
+validate() {  # validate <dir> -> validator exit code
+  python -m distributed_llm_training_benchmark_framework_tpu.analysis.validate_results \
+    --results-dir "$1/results" > "$1/validate.log" 2>&1
+}
+
+check_recovered() {  # check_recovered <fault> <dir>
+  local fault="$1" dir="$2"
+  if ! run_arm "$dir" "$dir/resume.log" --resume; then
+    fail "$fault" "resume attempt did not complete (see $dir/resume.log)"
+    return
+  fi
+  local row="$dir/results/result_ddp_ws1_seq32_tierS.json"
+  if [ ! -f "$row" ]; then fail "$fault" "no result row after resume"; return; fi
+  if ! python - "$row" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["resumed"] is True, f"resumed={r['resumed']}"
+assert r["n_restarts"] >= 1, f"n_restarts={r['n_restarts']}"
+assert r["resume_step"] >= 0, f"resume_step={r['resume_step']}"
+EOF
+  then fail "$fault" "resumed row missing honest accounting"; return; fi
+  if ! validate "$dir"; then
+    fail "$fault" "validate_results rejected the resumed row (see $dir/validate.log)"
+    return
+  fi
+  ok "$fault" "resumed from checkpoint; result validated with resumed=true"
+}
+
+for fault in $FAULTS; do
+  dir="$ROOT/$fault"
+  mkdir -p "$dir"
+  echo "=== chaos: $fault ==="
+  case "$fault" in
+    sigkill)
+      run_arm "$dir" "$dir/phase1.log" --inject-fault "sigkill@9"
+      rc=$?
+      if [ "$rc" -eq 0 ]; then fail "$fault" "run survived its own SIGKILL (rc=0)"; continue; fi
+      if ! ls "$dir/ckpt" 2>/dev/null | grep -q '^[0-9]*$'; then
+        fail "$fault" "no checkpoint committed before the kill"; continue
+      fi
+      check_recovered "$fault" "$dir"
+      ;;
+    sigterm)
+      run_arm "$dir" "$dir/phase1.log" --inject-fault "sigterm@9"
+      rc=$?
+      if [ "$rc" -ne 75 ]; then
+        fail "$fault" "expected EXIT_PREEMPTED (75), got rc=$rc"; continue
+      fi
+      if ! grep -aq '"event": "run_aborted".*"reason": "preempted"' \
+           "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "no run_aborted reason=preempted telemetry event"; continue
+      fi
+      if ! grep -aq '"reason": "preempted"' <(grep -a '^BENCHMARK_HEARTBEAT ' "$dir/phase1.log" | tail -1); then
+        fail "$fault" "final heartbeat does not carry reason=preempted"; continue
+      fi
+      check_recovered "$fault" "$dir"
+      ;;
+    torn-checkpoint)
+      run_arm "$dir" "$dir/phase1.log" --inject-fault "torn-checkpoint"
+      rc=$?
+      if [ "$rc" -eq 0 ]; then fail "$fault" "run survived its own SIGKILL (rc=0)"; continue; fi
+      check_recovered "$fault" "$dir"
+      if [ ! -d "$dir/ckpt/quarantine" ]; then
+        fail "$fault" "torn step was not quarantined"
+      elif ! grep -q "Resumed from checkpoint" "$dir/resume.log"; then
+        fail "$fault" "resume log does not show the fallback restore"
+      fi
+      ;;
+    nan-loss)
+      run_arm "$dir" "$dir/phase1.log" --inject-fault "nan-loss@8"
+      rc=$?
+      if [ "$rc" -ne 0 ]; then
+        fail "$fault" "run should complete (anomaly-screened), got rc=$rc"; continue
+      fi
+      if validate "$dir"; then
+        fail "$fault" "validate_results ACCEPTED a NaN-loss run"; continue
+      fi
+      if ! grep -q "unresolved anomaly" "$dir/validate.log"; then
+        fail "$fault" "rejection does not name the unresolved anomaly"; continue
+      fi
+      ok "$fault" "run completed; validator correctly rejected the row"
+      ;;
+    hang)
+      timeout -k 5 "${CHAOS_HANG_TIMEOUT:-60}" \
+        "${HARNESS[@]}" --results-dir "$dir/results" \
+        --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+        --inject-fault "hang@6:600" > "$dir/phase1.log" 2>&1
+      rc=$?
+      if [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then
+        fail "$fault" "expected a timeout kill (124/137), got rc=$rc"; continue
+      fi
+      if ! scripts/collect_results.sh --log "$dir/phase1.log" \
+           "$dir/salvage" > "$dir/collect.log" 2>&1; then
+        fail "$fault" "heartbeat salvage failed (see $dir/collect.log)"; continue
+      fi
+      if ! ls "$dir/salvage"/partial_*.json > /dev/null 2>&1; then
+        fail "$fault" "no partial_<arm>.json salvaged"; continue
+      fi
+      ok "$fault" "hang killed by timeout; classified as a partial row"
+      ;;
+    enospc-on-save)
+      run_arm "$dir" "$dir/phase1.log" --inject-fault "enospc-on-save"
+      rc=$?
+      if [ "$rc" -ne 0 ]; then
+        fail "$fault" "save failures must degrade, not kill (rc=$rc)"; continue
+      fi
+      if ! grep -q "checkpoint save at step .* failed" "$dir/phase1.log"; then
+        fail "$fault" "no save-degraded warning in the log"; continue
+      fi
+      if ! validate "$dir"; then
+        fail "$fault" "validate_results rejected the degraded-save run"; continue
+      fi
+      ok "$fault" "saves degraded with warnings; run completed and validated"
+      ;;
+    *)
+      fail "$fault" "unknown fault class"; continue
+      ;;
+  esac
+done
+
+echo ""
+echo "=== chaos suite: $PASS ok, $FAIL failed ==="
+for line in "${SUMMARY[@]}"; do echo "  $line"; done
+if [ "$KEEP" = "0" ] && [ "$FAIL" -eq 0 ] && [[ "$ROOT" == /tmp/chaos_suite.* ]]; then
+  rm -rf "$ROOT"
+else
+  echo "artifacts: $ROOT"
+fi
+[ "$FAIL" -eq 0 ]

@@ -52,11 +52,20 @@ import json, os, sys
 d = json.loads(os.environ["HB_JSON"])
 d["partial"] = True
 d["n_heartbeats"] = int(os.environ.get("N_HEARTBEATS", "0"))
+# Death classification (docs/FAULT_TOLERANCE.md): a preempted pod's LAST
+# heartbeat is the emergency one — it carries reason=preempted plus the
+# emergency checkpoint's metadata (step/loss at the save boundary), which
+# supersedes the older cadenced heartbeat's step. Anything without a
+# reason died uncleanly: a crash, not a preemption.
+d.setdefault("reason", "crash")
+if d.get("emergency_checkpoint_step") is not None:
+    d["step"] = d["emergency_checkpoint_step"]
 arm = d.get("arm", "unknown")
 path = os.path.join(sys.argv[1], f"partial_{arm}.json")
 with open(path, "w") as f:
     json.dump(d, f, indent=2)
-print(f"Extracted PARTIAL {path} (run died before the final result marker)")
+print(f"Extracted PARTIAL {path} ({d['reason']}: run died before the "
+      "final result marker)")
 EOF
 }
 

@@ -38,6 +38,13 @@ RING_ZIGZAG="auto"
 # grace window is derived inside scripts/liveness_probe.sh (10x, floor
 # 120s), so one knob moves scrape cadence and liveness together.
 HEARTBEAT_SEC="${HEARTBEAT_SEC:-30}"
+# SIGTERM grace (docs/FAULT_TOLERANCE.md): kubelet preemption sends
+# SIGTERM and waits terminationGracePeriodSeconds before SIGKILL. The
+# preemption handler (train/loop.py) acts at the NEXT sync-window
+# boundary and then writes an emergency checkpoint, so the grace must
+# cover one full sync window plus the save — 4x the heartbeat cadence
+# with a 120s floor tracks that (windows outpace heartbeats by design).
+TERMINATION_GRACE_SEC="${TERMINATION_GRACE_SEC:-}"
 IMAGE="tpu-llm-bench:latest"
 TPU_ACCELERATOR="${TPU_ACCELERATOR:-tpu-v5-lite-podslice}"
 TPU_TOPOLOGY="${TPU_TOPOLOGY:-2x4}"
@@ -71,6 +78,7 @@ while [ $# -gt 0 ]; do
     --causal) CAUSAL=1; shift 1 ;;
     --ring-zigzag) RING_ZIGZAG="$2"; shift 2 ;;
     --heartbeat-sec) HEARTBEAT_SEC="$2"; shift 2 ;;
+    --termination-grace-sec) TERMINATION_GRACE_SEC="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
     --job-name) JOB_NAME="$2"; shift 2 ;;
@@ -90,6 +98,14 @@ fi
 # tight test cadence doesn't hammer kubelet exec.
 LIVENESS_PERIOD="$HEARTBEAT_SEC"
 if [ "$LIVENESS_PERIOD" -lt 10 ] 2>/dev/null; then LIVENESS_PERIOD=10; fi
+# Default SIGTERM grace derived from the heartbeat cadence (see the knob
+# comment above): 4x cadence, floor 120s.
+if [ -z "$TERMINATION_GRACE_SEC" ]; then
+  TERMINATION_GRACE_SEC=$(( HEARTBEAT_SEC * 4 ))
+  if [ "$TERMINATION_GRACE_SEC" -lt 120 ] 2>/dev/null; then
+    TERMINATION_GRACE_SEC=120
+  fi
+fi
 echo "Launching: job=$JOB_NAME strategy=$STRATEGY world_size=$WORLD_SIZE hosts=$NUM_HOSTS"
 kubectl apply -f k8s/namespace.yaml
 kubectl apply -f k8s/serviceaccount.yaml
@@ -123,6 +139,7 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{RING_ZIGZAG}}|$RING_ZIGZAG|g" \
     -e "s|{{HEARTBEAT_SEC}}|$HEARTBEAT_SEC|g" \
     -e "s|{{LIVENESS_PERIOD}}|$LIVENESS_PERIOD|g" \
+    -e "s|{{TERMINATION_GRACE_SEC}}|$TERMINATION_GRACE_SEC|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \
     -e "s|{{TPU_ACCELERATOR}}|$TPU_ACCELERATOR|g" \
     -e "s|{{TPU_TOPOLOGY}}|$TPU_TOPOLOGY|g" \

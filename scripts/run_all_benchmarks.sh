@@ -93,6 +93,27 @@ SKIP_PREFLIGHT="${SKIP_PREFLIGHT:-0}"
 # too, or a flag-redirected CI run would dirty the repo's committed
 # seed and gate against unrelated history.
 SKIP_REGRESS="${SKIP_REGRESS:-0}"
+# Chaos smoke (scripts/chaos_suite.sh --smoke, docs/FAULT_TOLERANCE.md):
+# before burning slice time on the matrix, prove in ~a minute on the host
+# CPU that the recovery machinery works — a SIGKILL'd arm resumes from
+# its checkpoint and a torn checkpoint quarantines + falls back. Runs in
+# a throwaway tmpdir so its artifacts never pollute RESULTS_DIR, the
+# registry, or the report. SKIP_CHAOS=1 bypasses (same escape hatch as
+# SKIP_PREFLIGHT/SKIP_REGRESS); dry runs plan only and skip it too.
+SKIP_CHAOS="${SKIP_CHAOS:-0}"
+# Retrying orchestration (scripts/with_retries.sh): each local arm gets
+# MAX_ARM_RETRIES bounded retries with exponential backoff
+# (RETRY_BACKOFF_SEC), and retries RESUME from the arm's checkpoint dir
+# instead of cold-restarting — preemption (exit 75), OOM-kills and
+# timeouts all salvage their completed steps. ARM_CHECKPOINT_EVERY sets
+# the checkpoint cadence backing that resume: 'auto' = STEPS/4 (the
+# save sits at a sync boundary outside the timed windows, so headline
+# metrics are unaffected); 0 disables checkpointing and makes retries
+# cold. Resumed rows publish resumed=true/n_restarts and are never
+# regression baselines.
+MAX_ARM_RETRIES="${MAX_ARM_RETRIES:-1}"
+RETRY_BACKOFF_SEC="${RETRY_BACKOFF_SEC:-5}"
+ARM_CHECKPOINT_EVERY="${ARM_CHECKPOINT_EVERY:-auto}"
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -145,8 +166,28 @@ if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_PREFLIGHT" != "1" ]; then
   echo ""
 fi
 
+if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_CHAOS" != "1" ]; then
+  echo "=== Chaos smoke: recovery proof (sigkill + torn-checkpoint) ==="
+  CHAOS_DIR=$(mktemp -d /tmp/chaos_smoke.XXXXXX)
+  if scripts/chaos_suite.sh --smoke --results-dir "$CHAOS_DIR"; then
+    rm -rf "$CHAOS_DIR"
+  else
+    echo "CHAOS SMOKE FAILED — the recovery machinery is broken, so a" \
+         "preempted arm would be a total loss; not launching" \
+         "(SKIP_CHAOS=1 to override). Artifacts: $CHAOS_DIR"
+    exit 1
+  fi
+  echo ""
+fi
+
 PASS=0; FAIL=0
 SUITE_START=$(date +%s)
+
+# Resolve the auto checkpoint cadence now that STEPS is final.
+if [ "$ARM_CHECKPOINT_EVERY" = "auto" ]; then
+  ARM_CHECKPOINT_EVERY=$((STEPS / 4))
+  [ "$ARM_CHECKPOINT_EVERY" -lt 1 ] && ARM_CHECKPOINT_EVERY=1
+fi
 
 run_local() {
   local strategy="$1" ws="$2" extra="${3-$EXTRA_ARGS}" suffix="${4-$RUN_SUFFIX}"
@@ -160,14 +201,30 @@ run_local() {
   fi
   echo "--- $name ---"
   local t0=$(date +%s)
-  if timeout "$TIMEOUT_PER_RUN" python -u benchmarking/train_harness.py \
+  # Bounded retry with resume (with_retries.sh): the checkpoint cadence
+  # backs the resume; retries drop any injected chaos fault so a
+  # deterministic fault cannot re-fire on its own recovery attempt.
+  local ckpt_flags=""
+  if [ "$ARM_CHECKPOINT_EVERY" != "0" ]; then
+    # Fresh dir per invocation: the checkpoints only exist to back THIS
+    # suite run's retry-resume. A previous invocation's committed steps
+    # (RESULTS_DIR defaults to the persistent results/) would collide
+    # with this run's saves — and resuming last week's final state into
+    # a fresh measurement would be dishonest anyway.
+    rm -rf "$RESULTS_DIR/${name}_ckpt"
+    ckpt_flags="--checkpoint-dir $RESULTS_DIR/${name}_ckpt"
+    ckpt_flags="$ckpt_flags --checkpoint-every $ARM_CHECKPOINT_EVERY"
+  fi
+  if scripts/with_retries.sh \
+      ${ckpt_flags:+--resume-flag --resume} --drop-on-retry --inject-fault -- \
+      timeout "$TIMEOUT_PER_RUN" python -u benchmarking/train_harness.py \
       --strategy "$strategy" --world-size "$ws" --rank 0 \
       --tier "$TIER" --seq-len "$SEQ_LEN" --attention "$ATTENTION" \
       --steps "$STEPS" --warmup-steps "$WARMUP_STEPS" \
       --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
       --sync-every "$SYNC_EVERY" --layer-loop "$LAYER_LOOP" \
       --results-dir "$RESULTS_DIR/${name}_results" \
-      $extra \
+      $extra $ckpt_flags \
       > "$log" 2>&1; then
     scripts/collect_results.sh --log "$log" "$RESULTS_DIR/${name}_results" \
       || true
@@ -198,24 +255,44 @@ run_k8s() {
     PASS=$((PASS+1)); return
   fi
   echo "--- $job (k8s) ---"
-  scripts/launch_multi.sh --strategy "$strategy" --world-size "$ws" \
-    --seq-len "$SEQ_LEN" --tier "$TIER" --steps "$STEPS" \
-    --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
-    --attention "$ATTENTION" --layer-loop "$LAYER_LOOP" --job-name "$job" \
-    $comp \
-    ${IMAGE:+--image "$IMAGE"}
-  if kubectl -n "$NAMESPACE" wait --for=condition=complete \
-       "job/$job" --timeout=900s; then
-    scripts/collect_results.sh --k8s "$NAMESPACE" "$job" "$RESULTS_DIR"
-    PASS=$((PASS+1))
-  else
-    echo "FAILED — last 100 log lines:"
+  # Bounded retry, mirroring run_local's. k8s retries are COLD relaunches
+  # (the pod's emptyDir checkpoints die with it — resume across pods
+  # needs a persistent CHECKPOINT_DIR volume, which the operator wires
+  # via pod env overlays); what the loop buys is survival of preemption
+  # and transient scheduling failures without losing the whole matrix.
+  local attempt=0 done_ok=0
+  while :; do
+    attempt=$((attempt+1))
+    scripts/launch_multi.sh --strategy "$strategy" --world-size "$ws" \
+      --seq-len "$SEQ_LEN" --tier "$TIER" --steps "$STEPS" \
+      --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
+      --attention "$ATTENTION" --layer-loop "$LAYER_LOOP" --job-name "$job" \
+      $comp \
+      ${IMAGE:+--image "$IMAGE"}
+    if kubectl -n "$NAMESPACE" wait --for=condition=complete \
+         "job/$job" --timeout=900s; then
+      done_ok=1
+      break
+    fi
+    echo "FAILED (attempt $attempt) — last 100 log lines:"
     kubectl -n "$NAMESPACE" logs -l "job-name=$job" --tail=100 || true
     # Still collect: saves every pod's log for diagnosis and salvages a
     # partial_<arm>.json from the heartbeat markers when any pod got far
     # enough to print one (the pod filesystem dies with the pod — the
     # scrape is the only copy).
     scripts/collect_results.sh --k8s "$NAMESPACE" "$job" "$RESULTS_DIR" || true
+    kubectl -n "$NAMESPACE" delete job "$job" --ignore-not-found
+    if [ "$attempt" -gt "$MAX_ARM_RETRIES" ]; then
+      break
+    fi
+    backoff=$((RETRY_BACKOFF_SEC * (1 << (attempt - 1))))
+    echo "retrying $job in ${backoff}s..."
+    sleep "$backoff"
+  done
+  if [ "$done_ok" -eq 1 ]; then
+    scripts/collect_results.sh --k8s "$NAMESPACE" "$job" "$RESULTS_DIR"
+    PASS=$((PASS+1))
+  else
     FAIL=$((FAIL+1))
   fi
   kubectl -n "$NAMESPACE" delete job "$job" --ignore-not-found

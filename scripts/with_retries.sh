@@ -1,0 +1,85 @@
+#!/usr/bin/env bash
+# Bounded retry wrapper for one benchmark arm (the chaos-harness
+# orchestration core, docs/FAULT_TOLERANCE.md).
+#
+#   with_retries.sh [--resume-flag FLAG] [--drop-on-retry FLAG] -- cmd args...
+#
+# Runs the command; on a nonzero exit retries up to MAX_ARM_RETRIES times
+# with exponential backoff. Retries are RESUMES, not cold restarts: when
+# --resume-flag is given it is appended to the command from attempt 2 on
+# (the harness restores the newest valid checkpoint; an empty/torn
+# checkpoint dir degrades to a cold start inside the harness itself, so
+# appending unconditionally is safe). A --drop-on-retry flag (and its
+# value, when the next token is not another flag) is removed from retry
+# attempts — the hook that keeps an injected chaos fault
+# (--inject-fault sigkill@N) from re-firing on every resume; the
+# INJECT_FAULT env var is cleared on retries for the same reason.
+#
+# Env contract (mirrors the SKIP_* knobs elsewhere in scripts/):
+#   MAX_ARM_RETRIES    retries after the first attempt (default 1; 0 = off)
+#   RETRY_BACKOFF_SEC  base backoff, doubled each retry (default 5)
+#
+# Exit code: the final attempt's (so a run that stays broken still fails
+# the suite with its real code — including EXIT_PREEMPTED 75 when every
+# grace window was exhausted).
+set -uo pipefail
+
+MAX_ARM_RETRIES="${MAX_ARM_RETRIES:-1}"
+RETRY_BACKOFF_SEC="${RETRY_BACKOFF_SEC:-5}"
+EXIT_PREEMPTED=75
+# Deterministic refusal (harness: resume found no steps left to run) —
+# never retried; every attempt would refuse identically.
+EXIT_NOTHING_TO_RESUME=76
+
+RESUME_FLAG=""
+DROP_ON_RETRY=""
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --resume-flag) RESUME_FLAG="$2"; shift 2 ;;
+    --drop-on-retry) DROP_ON_RETRY="$2"; shift 2 ;;
+    --) shift; break ;;
+    *) echo "with_retries: unknown flag $1" >&2; exit 2 ;;
+  esac
+done
+if [ $# -eq 0 ]; then
+  echo "usage: with_retries.sh [--resume-flag FLAG] [--drop-on-retry FLAG] -- cmd args..." >&2
+  exit 2
+fi
+
+attempt=0
+rc=0
+while :; do
+  attempt=$((attempt + 1))
+  if [ "$attempt" -eq 1 ]; then
+    "$@"
+    rc=$?
+  else
+    # Rebuild the argv for a resume attempt: drop the chaos-injection
+    # flag (+ its value), clear the env fallback, append the resume flag.
+    RETRY_CMD=()
+    skip_next=0
+    for tok in "$@"; do
+      if [ "$skip_next" -eq 1 ]; then skip_next=0; continue; fi
+      if [ -n "$DROP_ON_RETRY" ] && [ "$tok" = "$DROP_ON_RETRY" ]; then
+        skip_next=1
+        continue
+      fi
+      RETRY_CMD+=("$tok")
+    done
+    if [ -n "$RESUME_FLAG" ]; then RETRY_CMD+=("$RESUME_FLAG"); fi
+    INJECT_FAULT="" "${RETRY_CMD[@]}"
+    rc=$?
+  fi
+  [ "$rc" -eq 0 ] && exit 0
+  if [ "$rc" -eq "$EXIT_NOTHING_TO_RESUME" ] \
+     || [ "$attempt" -gt "$MAX_ARM_RETRIES" ]; then
+    exit "$rc"
+  fi
+  kind="exit=$rc"
+  [ "$rc" -eq "$EXIT_PREEMPTED" ] && kind="preempted (exit=$rc)"
+  backoff=$((RETRY_BACKOFF_SEC * (1 << (attempt - 1))))
+  echo "with_retries: attempt $attempt failed [$kind]; retrying" \
+       "${RESUME_FLAG:+with $RESUME_FLAG }in ${backoff}s" \
+       "($((MAX_ARM_RETRIES - attempt + 1)) retr$( [ $((MAX_ARM_RETRIES - attempt + 1)) -eq 1 ] && echo y || echo ies) left)" >&2
+  sleep "$backoff"
+done

@@ -115,7 +115,7 @@ def test_lint_is_clean_on_head():
 
 def test_rule_catalog_is_complete():
     assert set(lint.RULES) == {
-        "GC101", "GC102", "GC103", "GC104", "GC105", "GC201",
+        "GC101", "GC102", "GC103", "GC104", "GC105", "GC106", "GC201",
     }
     for rule in lint.RULES.values():
         assert rule.fix_hint and rule.description
@@ -264,6 +264,74 @@ def test_gc105_clean_on_head():
     """train/loop.py's real recorder call sites all sit at sync
     boundaries — the discipline the rule exists to keep."""
     assert lint.run_lint(rules=("GC105",)) == []
+
+
+def test_gc106_fires_on_signal_install_in_timed_loop(tmp_path):
+    """A signal-handler swap inside the loop is flagged even when fenced —
+    handlers install once, outside (faults/preemption.py)."""
+    root = _scratch_root(tmp_path, "train/loop.py", """\
+        import signal
+
+        def run(steps, step_fn, state, handler):
+            def sync_window():
+                pass
+
+            for step in range(steps):
+                state, loss = step_fn(state, step)
+                sync_window()
+                signal.signal(signal.SIGTERM, handler)  # fenced, still wrong
+            return state
+    """)
+    violations = lint.run_lint(root=root, rules=("GC106",))
+    assert len(violations) == 1
+    assert "signal.signal" in violations[0].message
+
+
+def test_gc106_fires_on_unfenced_fsync_and_honors_fence(tmp_path):
+    root = _scratch_root(tmp_path, "train/loop.py", """\
+        import os
+
+        def run(steps, step_fn, state, fd):
+            def sync_window():
+                pass
+
+            for step in range(steps):
+                state, loss = step_fn(state, step)
+                os.fsync(fd)  # unfenced: blocks inside the timed window
+                sync_window()
+                os.fsync(fd)  # fenced: checkpoint-boundary durability
+            return state
+    """)
+    violations = lint.run_lint(root=root, rules=("GC106",))
+    assert len(violations) == 1
+    assert "os.fsync" in violations[0].message
+    assert violations[0].line == 9
+
+
+def test_gc106_suppression_and_outside_loop_clean(tmp_path):
+    root = _scratch_root(tmp_path, "train/loop.py", """\
+        import os
+        import signal
+
+        def run(steps, step_fn, state, fd, handler):
+            signal.signal(signal.SIGTERM, handler)  # outside: sanctioned
+
+            def sync_window():
+                pass
+
+            for step in range(steps):
+                state, loss = step_fn(state, step)
+                os.fsync(fd)  # graftcheck: disable=GC106
+            return state
+    """)
+    assert lint.run_lint(root=root, rules=("GC106",)) == []
+
+
+def test_gc106_clean_on_head():
+    """The real loop installs its SIGTERM guard in run_benchmark, before
+    the first dispatch; durable writes live in runtime/checkpoint.py at
+    checkpoint boundaries — the discipline this rule pins."""
+    assert lint.run_lint(rules=("GC106",)) == []
 
 
 def test_gc104_fires_on_time_time(tmp_path):

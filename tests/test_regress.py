@@ -130,6 +130,99 @@ def test_partial_records_never_baseline(tmp_path):
     assert 9000.0 not in vals
 
 
+def test_resumed_records_never_baseline(tmp_path):
+    """Chaos-round satellite: a stitched run (resumed=true) joins partials
+    in the never-baseline-eligible set — its first window folds in the
+    restore recompile, so it is an honest record but a dishonest anchor."""
+    reg = rstore.Registry(str(tmp_path / "reg"))
+    clean = rstore.make_record(
+        arm="arm1", result_row=result_row(), windows=windows(BASE_DTS),
+        tokens_per_step=1024, status="ok", source="result_arm1.json",
+    )
+    reg.ingest(clean)
+    stitched = rstore.make_record(
+        arm="arm1",
+        result_row=result_row(tokens_per_sec=4000.0, resumed=True,
+                              n_restarts=1, resume_step=25),
+        status="ok", source="resumed/result_arm1.json",
+    )
+    reg.ingest(stitched)
+    base = reg.baseline("arm1")
+    assert base is not None and base["record_id"] == clean["record_id"]
+    vals = reg.history_values("arm1", metric_name="tokens_per_sec")
+    assert 4000.0 not in vals
+    # The gate never verdicts a resumed candidate either: recovery noise
+    # must not mint a regression.
+    verdict, line = rcompare.gate_arm(reg, "arm1")
+    assert verdict == rstats.VERDICT_INSUFFICIENT
+    assert "resumed (stitched) run" in line
+
+
+def test_banked_regression_skipped_by_last_good(tmp_path):
+    """ROADMAP benchreg follow-up (b): a banked regression is never
+    adopted as last-good; unbank lifts it. The banked ledger is
+    append-only action lines."""
+    reg = rstore.Registry(str(tmp_path / "reg"))
+    good = rstore.make_record(
+        arm="arm1", result_row=result_row(), status="ok", source="r1.json",
+    )
+    reg.ingest(good)
+    regressed = rstore.make_record(
+        arm="arm1", result_row=result_row(tokens_per_sec=4600.0),
+        status="ok", source="r2.json",
+    )
+    reg.ingest(regressed)
+    # Un-banked, the newer record would be the baseline.
+    assert reg.baseline("arm1")["record_id"] == regressed["record_id"]
+    assert reg.bank(regressed["record_id"], reason="gate: REGRESSION ...")
+    assert not reg.bank(regressed["record_id"])  # idempotent
+    assert reg.baseline("arm1")["record_id"] == good["record_id"]
+    assert 4600.0 not in reg.history_values(
+        "arm1", metric_name="tokens_per_sec"
+    )
+    # Trend still shows it, flagged.
+    rows = rcompare.trend_rows(reg, "arm1")
+    assert [r["banked"] for r in rows] == [False, True]
+    assert reg.unbank(regressed["record_id"])
+    assert reg.baseline("arm1")["record_id"] == regressed["record_id"]
+    # A torn trailing append (SIGKILL mid-write — the environment this
+    # ledger serves) must not wedge every read path with a traceback.
+    with open(reg.banked_path, "a") as f:
+        f.write('{"record_id": "deadbeef", "acti')
+    assert reg.banked_ids() == set()
+    assert reg.baseline("arm1") is not None
+
+
+def test_gate_banks_regressed_candidate(frozen_registry, capsys):
+    """A REGRESSION verdict on the default last-good/latest path banks
+    the candidate, so the NEXT run's last-good skips it instead of
+    adopting the regressed number as the new normal."""
+    reg0 = rstore.Registry(frozen_registry)
+    slow = json.load(
+        open(os.path.join(FROZEN_CANDIDATES, "record_slow.json"))
+    )
+    _, created = reg0.ingest(slow)
+    assert created
+    rc = rcompare.main(["--registry", frozen_registry, "gate", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "banked candidate" in out
+    reg = rstore.Registry(frozen_registry)
+    banked = reg.banked_ids()
+    assert len(banked) == 1
+    # The regressed record is no longer anyone's last-good...
+    bad_id = next(iter(banked))
+    base = reg.baseline(FROZEN_ARM)
+    assert base is not None and base["record_id"] != bad_id
+    # ...and the CLI can lift the bank.
+    rc = rcompare.main(
+        ["--registry", frozen_registry, "unbank", bad_id,
+         "--reason", "accepted as the new normal"]
+    )
+    assert rc == 0
+    assert rstore.Registry(frozen_registry).banked_ids() == set()
+
+
 def test_partial_result_file_ingests_as_partial(tmp_path):
     """End-to-end satellite proof: collect_results.sh's salvage file ->
     status partial -> gate SKIPs rather than verdicts."""
