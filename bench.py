@@ -80,7 +80,8 @@ FLAGSHIP_LAYER_LOOP = "unrolled"
 
 
 def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
-                 layer_loop, attention_impl=None, dropout="inherit"):
+                 layer_loop, attention_impl=None, dropout="inherit",
+                 use_checkpoint=True):
     """Run one benchmark arm and return its contract-shaped row dict.
 
     Shared by the parity row and the flagship sub-object so the contract
@@ -93,6 +94,10 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
     from distributed_llm_training_benchmark_framework_tpu.train.loop import run_benchmark
 
     # Keep stdout clean for the single JSON line; progress goes to stderr.
+    # Checkpointing (off by default — a headline measurement doesn't
+    # checkpoint): --checkpoint-dir/-every/-async thread through so the
+    # async-delta cadence is measurable from the headline driver too
+    # (time_in_checkpoint_sec rides the contract row's phase fields).
     with contextlib.redirect_stdout(sys.stderr):
         result = run_benchmark(
             strategy=get_strategy(args.strategy),
@@ -111,6 +116,9 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
             dropout=args.dropout if dropout == "inherit" else dropout,
             sync_every=args.sync_every,
             layer_loop=layer_loop,
+            checkpoint_dir=args.checkpoint_dir if use_checkpoint else None,
+            checkpoint_every=args.checkpoint_every if use_checkpoint else 0,
+            checkpoint_async=args.checkpoint_async and use_checkpoint,
         )
     per_chip = result.tokens_per_sec / world
     return {
@@ -186,6 +194,15 @@ def build_parser():
     # runs before any arm launches; see run_preflight for scope.
     p.add_argument("--skip-preflight", action="store_true",
                    help="skip the graftcheck static preflight gate")
+    # Checkpoint cadence (off by default): measure the checkpoint tax —
+    # with --checkpoint-async the periodic saves leave the timed path and
+    # time_in_checkpoint_sec shows the saving directly.
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0)
+    p.add_argument("--checkpoint-async", action="store_true",
+                   help="async periodic saves (orbax async writer, commit "
+                        "fenced at sync boundaries) — the emergency path "
+                        "then only flushes the in-flight delta")
     # Run-registry integration (regress/, docs/REGRESSION.md): 'auto'
     # ingests this invocation's rows and prints a one-line verdict vs the
     # last known good WHEN a registry already exists (seeded at
@@ -242,6 +259,9 @@ def main():
                 layer_loop=FLAGSHIP_LAYER_LOOP,
                 attention_impl="flash",
                 dropout=None,  # the family's native 0.0
+                # A shared --checkpoint-dir must not mix two arms' states
+                # in one directory; checkpointing belongs to the top row.
+                use_checkpoint=False,
             ),
             # Run-identity provenance: exactly which configuration produced
             # the flagship number (the §16 swept geometry).

@@ -75,7 +75,7 @@ def _partial_row(p: dict) -> dict:
     # collect script stamps reason=preempted|crash, and a resumed arm's
     # heartbeats carry resumed/n_restarts — the report separates a
     # preempted pod (checkpointed, resumable) from a genuine crash.
-    for k in ("reason", "resumed", "n_restarts"):
+    for k in ("reason", "resumed", "n_restarts", "resume_geometry_changed"):
         if k in p:
             row[k] = p[k]
     return row

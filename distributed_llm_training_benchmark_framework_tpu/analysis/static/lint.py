@@ -61,6 +61,13 @@ ENTRYPOINT_EXEMPT_FLAGS = frozenset(
     {"--local-rank", "--deepspeed-config", "--fsdp-config"}
 )
 
+#: Flags the entrypoint passes to scripts/with_retries.sh (the retry
+#: wrapper it execs in retry mode) — wrapper surface, not harness surface,
+#: so they are neither "stale" nor expected in build_parser().
+ENTRYPOINT_WRAPPER_FLAGS = frozenset(
+    {"--drop-on-retry", "--resume-flag"}
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class Rule:
@@ -586,7 +593,7 @@ def _check_entrypoint_drift(root: str) -> Iterator[Violation]:
     text = open(entrypoint).read()
     entry_flags = set(_FLAG_TOKEN.findall(text))
 
-    stale = entry_flags - parser_flags
+    stale = entry_flags - parser_flags - ENTRYPOINT_WRAPPER_FLAGS
     if stale:
         yield Violation(
             "GC201", "docker/entrypoint.sh", 1,

@@ -40,7 +40,12 @@ import glob
 import os
 from typing import Any, Dict, List, Optional
 
-from ..telemetry import PHASES, read_events
+from ..telemetry import (
+    PHASES,
+    is_rank_sibling,
+    rank_telemetry_files,
+    read_events,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -195,6 +200,68 @@ def format_report(tl: Dict[str, Any]) -> str:
                 tag = "UNRESOLVED"
             out.append(f"  step {a.get('step')}: {a.get('kind')} [{tag}] "
                        f"{a.get('detail', '')}")
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Per-rank merge (multi-host runs)
+# ---------------------------------------------------------------------------
+
+
+def merge_rank_timelines(
+    path: str, rank0_tl: Optional[Dict[str, Any]] = None
+) -> Dict[int, Dict[str, Any]]:
+    """{rank: timeline} for a rank-0 telemetry file and its rank siblings.
+
+    Multi-host runs stream one ``telemetry_<arm>.rank<r>.jsonl`` per
+    non-zero rank beside the canonical file (telemetry.telemetry_filename)
+    — merging them is what makes a straggling or preempted NON-ZERO rank
+    visible directly instead of only through rank 0's window times.
+    Unreadable rank files are skipped (a SIGKILL'd rank's torn tail is
+    already tolerated by read_events). ``rank0_tl`` lets a caller that
+    already built the canonical file's timeline skip re-reading it.
+    """
+    out: Dict[int, Dict[str, Any]] = {}
+    for rank, rpath in sorted(rank_telemetry_files(path).items()):
+        if rank == 0 and rank0_tl is not None:
+            out[0] = rank0_tl
+            continue
+        try:
+            events = read_events(rpath)
+        except (OSError, ValueError):
+            continue
+        if events:
+            out[rank] = build_timeline(events)
+    return out
+
+
+def format_rank_merge(ranks: Dict[int, Dict[str, Any]]) -> str:
+    """Straggler/preemption table across a run's per-rank streams."""
+    out: List[str] = [f"== Per-rank telemetry ({len(ranks)} ranks) =="]
+    max_step = max(
+        (tl["windows"][-1]["step"] for tl in ranks.values() if tl["windows"]),
+        default=None,
+    )
+    for rank, tl in sorted(ranks.items()):
+        end = tl["end"]
+        last_step = tl["windows"][-1]["step"] if tl["windows"] else None
+        if end is None:
+            status = "KILLED (no terminal event)"
+        elif end["event"] == "run_aborted":
+            status = f"aborted: {end.get('reason')}"
+        else:
+            status = f"completed ({end.get('status')})"
+        straggle = ""
+        if (
+            max_step is not None and last_step is not None
+            and last_step < max_step
+        ):
+            straggle = f"  <-- straggler ({max_step - last_step} steps behind)"
+        out.append(
+            f"  rank {rank}: last step "
+            f"{'-' if last_step is None else last_step}, wall "
+            f"{tl['wall']:.2f}s, {status}{straggle}"
+        )
     return "\n".join(out)
 
 
@@ -367,8 +434,13 @@ def write_plots(tl: Dict[str, Any], out_dir: str) -> List[str]:
 
 def _discover(results_dir: str) -> List[str]:
     return sorted(
-        glob.glob(os.path.join(results_dir, "**", "telemetry_*.jsonl"),
-                  recursive=True)
+        p for p in glob.glob(
+            os.path.join(results_dir, "**", "telemetry_*.jsonl"),
+            recursive=True,
+        )
+        # Rank siblings report under their rank-0 file's per-rank section,
+        # not as standalone runs.
+        if not is_rank_sibling(p)
     )
 
 
@@ -417,6 +489,10 @@ def main(argv=None) -> int:
         tl = build_timeline(events)
         print(f"File: {path}")
         print(format_report(tl))
+        ranks = merge_rank_timelines(path, rank0_tl=tl)
+        if len(ranks) > 1:
+            print()
+            print(format_rank_merge(ranks))
         if args.profile_dir:
             print()
             try:

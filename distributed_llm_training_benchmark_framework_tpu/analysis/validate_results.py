@@ -232,6 +232,16 @@ def validate_result(r: dict, name: str) -> List[str]:
             "resumed=false — restart accounting is incoherent"
         )
 
+    # Elastic-resume coherence: a geometry-changed stitch IS a resume —
+    # the flag without resumed=true means the accounting (and therefore
+    # the never-baseline exclusion downstream) is broken.
+    if r.get("resume_geometry_changed") and not r.get("resumed"):
+        f.append(
+            f"{name}: resume_geometry_changed=true on a row with "
+            "resumed=false — a resharded restore is a resume; the "
+            "stitch accounting is incoherent"
+        )
+
     # MFU floors for the published-arm geometry only: tier A, single chip,
     # v5e, flash attention, dense model, device-resident optimizer, and
     # windowed timing (sync_every > 1 — the per-step block_until_ready

@@ -38,6 +38,11 @@ FAULT_KINDS = {
                "(the honest crash: no handlers, no flushes)",
     "sigterm": "SIGTERM self at the first sync boundary with step >= N "
                "(exercises the preemption handler end to end)",
+    "sigterm-rank": "sigterm-rank@N:R — SIGTERM self at the first sync "
+                    "boundary with step >= N, but ONLY on rank R "
+                    "(exercises the cross-host preempt-soon broadcast: "
+                    "every OTHER rank must learn of the preemption via "
+                    "the coordination-service flag, not a signal)",
     "nan-loss": "corrupt step N's loss to NaN (trips the recorder's "
                 "anomaly screen; validate_results must reject the row)",
     "hang": "sleep at the first sync boundary with step >= N "
@@ -51,7 +56,9 @@ FAULT_KINDS = {
 }
 
 #: Kinds that take a mandatory ``@N`` step.
-STEPPED_KINDS = frozenset({"sigkill", "sigterm", "nan-loss", "hang"})
+STEPPED_KINDS = frozenset(
+    {"sigkill", "sigterm", "sigterm-rank", "nan-loss", "hang"}
+)
 
 #: Default stall for ``hang`` when the spec carries no ``:SECS``. Long
 #: enough that any sane per-run timeout (or the k8s liveness probe) fires
@@ -66,6 +73,10 @@ class FaultSpec:
     kind: str
     step: Optional[int] = None
     hang_sec: Optional[float] = None
+    # sigterm-rank@N:R — the one rank that receives the signal. Every rank
+    # parses the same spec (the suite passes one value to every worker);
+    # the injector compares against its own rank at fire time.
+    rank: Optional[int] = None
 
     def __str__(self) -> str:
         s = self.kind
@@ -73,16 +84,19 @@ class FaultSpec:
             s += f"@{self.step}"
         if self.hang_sec is not None:
             s += f":{self.hang_sec:g}"
+        if self.rank is not None:
+            s += f":{self.rank}"
         return s
 
 
 def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
     """``"sigkill@10"`` -> FaultSpec; None/empty -> None; junk raises.
 
-    Grammar: ``KIND`` | ``KIND@STEP`` | ``hang@STEP:SECS``. Stepped kinds
-    *require* the step (a fault with no defined firing point would not be
-    reproducible); the save-path kinds refuse one (they fire on save
-    events, not steps).
+    Grammar: ``KIND`` | ``KIND@STEP`` | ``hang@STEP:SECS`` |
+    ``sigterm-rank@STEP:RANK``. Stepped kinds *require* the step (a fault
+    with no defined firing point would not be reproducible) —
+    ``sigterm-rank`` additionally requires the target rank; the save-path
+    kinds refuse one (they fire on save events, not steps).
     """
     if not spec:
         return None
@@ -100,9 +114,15 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
                 "(a fault without a firing step is not reproducible)"
             )
         step_str, _, secs_str = rest.partition(":")
-        if secs_str and kind != "hang":
+        if secs_str and kind not in ("hang", "sigterm-rank"):
             raise ValueError(
-                f"only 'hang' takes a duration suffix, got {spec!r}"
+                f"only 'hang' and 'sigterm-rank' take a suffix, got {spec!r}"
+            )
+        if kind == "sigterm-rank" and not secs_str:
+            raise ValueError(
+                "sigterm-rank needs a target rank: sigterm-rank@N:R "
+                "(without one the fault is 'sigterm' — which rank dies is "
+                "the whole point of the spec)"
             )
         try:
             step = int(step_str)
@@ -111,7 +131,17 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
         if step < 0:
             raise ValueError(f"fault step must be >= 0, got {spec!r}")
         hang_sec = None
-        if secs_str:
+        rank = None
+        if secs_str and kind == "sigterm-rank":
+            try:
+                rank = int(secs_str)
+            except ValueError:
+                raise ValueError(
+                    f"sigterm-rank target must be an integer rank, got {spec!r}"
+                )
+            if rank < 0:
+                raise ValueError(f"fault rank must be >= 0, got {spec!r}")
+        elif secs_str:
             try:
                 hang_sec = float(secs_str)
             except ValueError:
@@ -120,7 +150,7 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
                 )
             if hang_sec <= 0:
                 raise ValueError(f"hang duration must be > 0, got {spec!r}")
-        return FaultSpec(kind=kind, step=step, hang_sec=hang_sec)
+        return FaultSpec(kind=kind, step=step, hang_sec=hang_sec, rank=rank)
     if rest:
         raise ValueError(
             f"fault {kind!r} fires on checkpoint saves and takes no @step "
@@ -167,10 +197,14 @@ class FaultInjector:
     """
 
     def __init__(self, spec: Optional[FaultSpec] = None, recorder=None,
-                 is_main: bool = True):
+                 is_main: bool = True, rank: int = 0):
         self.spec = spec
         self.recorder = recorder
         self.is_main = is_main
+        # This process's rank — the sigterm-rank kind fires only when it
+        # matches the spec's target (every worker of a multi-host run is
+        # handed the same spec string).
+        self.rank = rank
         self.fired = False
 
     @property
@@ -195,7 +229,9 @@ class FaultInjector:
         """Fire sigkill/sigterm/hang at the first boundary past the step."""
         if (
             self.spec is None or self.fired
-            or self.spec.kind not in ("sigkill", "sigterm", "hang")
+            or self.spec.kind not in (
+                "sigkill", "sigterm", "sigterm-rank", "hang"
+            )
             or last_step < (self.spec.step or 0)
         ):
             return
@@ -205,6 +241,17 @@ class FaultInjector:
             os.kill(os.getpid(), signal.SIGKILL)
         elif self.spec.kind == "sigterm":
             self._announce(f"SIGTERM at sync boundary, step {last_step}")
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif self.spec.kind == "sigterm-rank":
+            if self.rank != (self.spec.rank or 0):
+                # Not this worker's fault to fire: the kill lands on rank
+                # R only, and THIS rank must learn of the preemption from
+                # the cross-host broadcast — that asymmetry is what the
+                # spec exists to prove.
+                return
+            self._announce(
+                f"SIGTERM (rank {self.rank}) at sync boundary, step {last_step}"
+            )
             os.kill(os.getpid(), signal.SIGTERM)
         else:  # hang
             secs = self.spec.hang_sec or HANG_DEFAULT_SEC

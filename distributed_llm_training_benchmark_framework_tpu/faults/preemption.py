@@ -65,12 +65,27 @@ class PreemptionGuard:
     installed — non-main threads (embedded callers, some test runners)
     raise ValueError from ``signal.signal``; such runs simply keep the
     supervisor-kill behavior they had before this round.
+
+    Multi-host coordination (elastic-resilience round): on a
+    ``jax.distributed`` rendezvous the host-local flag alone is not enough
+    — PR 5's guard only saved when *rank 0* was the SIGTERM'd host.
+    :meth:`coordinate` broadcasts the flag over the coordination service's
+    KV store (``runtime.distributed``) and agrees a single stop boundary
+    with every peer, so ANY rank's SIGTERM produces one coherent all-host
+    emergency checkpoint and a unanimous EXIT_PREEMPTED.
     """
 
     def __init__(self, enabled: bool = True):
         self._requested = False
         self._prev = None
         self.installed = False
+        self._published = False
+        self._agreed_step: Optional[int] = None
+        #: True once the cross-host agreement ran (successfully or
+        #: degraded) — either way it must not re-run: a dead peer would
+        #: otherwise re-block every later boundary for the full ack
+        #: timeout, stalling each remaining timed window.
+        self._agreement_done = False
         if not enabled:
             return
         try:
@@ -87,6 +102,50 @@ class PreemptionGuard:
     @property
     def requested(self) -> bool:
         return self._requested
+
+    def coordinate(self, boundary_step: int) -> Optional[int]:
+        """Cross-host poll at one fenced sync-window boundary.
+
+        Returns the step at which the loop must emergency-stop (stop at
+        the first boundary >= it), or None to keep running. Single-process
+        runs reduce to the local flag. Multi-process runs publish the
+        local flag when set, poll the peers' flags (non-blocking, ~1 ms),
+        and on any visible flag run the ack agreement once — the result
+        (including a degraded no-agreement outcome) is cached so later
+        boundaries pay only the local check.
+
+        Call sites must be boundary-aligned across hosts (the loop's poll
+        site is: pending empty, same step grid everywhere) — the blocking
+        agreement assumes every peer reaches its own next boundary.
+        """
+        if self._agreement_done:
+            # Agreement already ran. A degraded outcome (dead peer, no
+            # agreed step) still honors a LATER local SIGTERM — stop at
+            # this boundary best-effort rather than ignoring the signal.
+            if self._agreed_step is not None:
+                return self._agreed_step
+            return boundary_step if self._requested else None
+        import jax
+
+        if jax.process_count() <= 1:
+            return boundary_step if self._requested else None
+
+        from ..runtime import distributed as dist
+
+        if self._requested and not self._published:
+            self._published = dist.publish_preempt_flag(boundary_step)
+        if not self._requested and not dist.preempt_flag_entries():
+            return None
+        agreed = dist.agree_preempt_step(boundary_step)
+        if agreed is None:
+            # A peer never acked (died outright): degrade to a local
+            # best-effort stop when WE were signalled, else keep running
+            # — wedging every healthy host on a dead peer would turn one
+            # preemption into a whole-job loss.
+            agreed = boundary_step if self._requested else None
+        self._agreed_step = agreed
+        self._agreement_done = True
+        return agreed
 
     def uninstall(self) -> None:
         """Restore the previous handler (idempotent)."""

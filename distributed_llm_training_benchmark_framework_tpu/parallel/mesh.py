@@ -64,3 +64,47 @@ def make_mesh(
         # CPU/virtual-device fallback: plain row-major reshape.
         dev_array = np.asarray(list(devices)).reshape(tuple(shape))
     return Mesh(dev_array, axis_names)
+
+
+# ---------------------------------------------------------------------------
+# Mesh / PartitionSpec (de)serialization — the checkpoint geometry contract
+# ---------------------------------------------------------------------------
+#
+# Elastic resume (runtime/checkpoint.py, docs/FAULT_TOLERANCE.md) persists
+# each checkpoint's mesh geometry and per-leaf PartitionSpecs in a JSON
+# sidecar, so a later run on a DIFFERENT mesh can decide reshard-vs-refuse
+# without deserializing any payload. These helpers are the one place that
+# defines the JSON shape (a spec entry is None | axis name | [axis names]).
+
+
+def mesh_axes_dict(mesh: Mesh) -> dict:
+    """{'data': 4, 'model': 2, ...} — the geometry identity of a mesh."""
+    return {str(name): int(size) for name, size in mesh.shape.items()}
+
+
+def spec_to_jsonable(spec) -> list:
+    """jax.sharding.PartitionSpec -> JSON-serializable entry list."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(ax) for ax in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def jsonable_to_spec(entries):
+    """Inverse of :func:`spec_to_jsonable`."""
+    from jax.sharding import PartitionSpec as P
+
+    parts = []
+    for entry in entries or []:
+        if entry is None:
+            parts.append(None)
+        elif isinstance(entry, list):
+            parts.append(tuple(entry))
+        else:
+            parts.append(entry)
+    return P(*parts)

@@ -496,7 +496,12 @@ class Registry:
                 continue
             if rec.get("record_id") in banked:
                 continue
-            if (rec.get("result") or {}).get("resumed"):
+            res = rec.get("result") or {}
+            # resume_geometry_changed implies resumed, but a row whose
+            # accounting is broken (flag without resumed) must STILL stay
+            # out of the baseline set — defense in depth for the elastic
+            # stitch (docs/FAULT_TOLERANCE.md).
+            if res.get("resumed") or res.get("resume_geometry_changed"):
                 continue
             if exclude_record_id and rec.get("record_id") == exclude_record_id:
                 continue
@@ -528,7 +533,8 @@ class Registry:
                 continue
             if rec.get("record_id") in banked:
                 continue
-            if (rec.get("result") or {}).get("resumed"):
+            res = rec.get("result") or {}
+            if res.get("resumed") or res.get("resume_geometry_changed"):
                 continue
             if exclude_record_id and rec.get("record_id") == exclude_record_id:
                 continue

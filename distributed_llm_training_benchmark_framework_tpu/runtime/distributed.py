@@ -23,9 +23,26 @@ GPU count), never processes.
 from __future__ import annotations
 
 import os
-from typing import Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 import jax
+
+#: Key namespaces on the coordination service's key-value store used by the
+#: cross-host "preempt soon" broadcast (elastic-resilience round,
+#: docs/FAULT_TOLERANCE.md). The store lives in the same coordinator process
+#: that carries rendezvous heartbeats, so the channel costs no device work
+#: and stays available for exactly the lifetime of the run — a retried
+#: attempt gets a fresh coordinator and therefore a clean namespace.
+_PREEMPT_FLAG_PREFIX = "benchpreempt/flag/"
+_PREEMPT_ACK_PREFIX = "benchpreempt/ack/"
+
+#: How long one host waits for every other host's preemption ack before
+#: degrading to a local-only decision. The acks arrive at the peers' next
+#: sync-window boundaries — milliseconds-to-seconds apart in a lockstep
+#: run — so a timeout means a peer died outright, and waiting longer only
+#: burns the SIGTERM grace window.
+PREEMPT_ACK_TIMEOUT_SEC = float(os.environ.get("PREEMPT_ACK_TIMEOUT_SEC", 60))
 
 
 def setup_distributed(
@@ -88,9 +105,141 @@ def barrier(name: str = "benchmark_end") -> None:
     reference train_harness.py:396-397). Uses the jit/GSPMD-era
     ``sync_global_devices`` (an all-gather across every device, keyed by
     ``name`` so mismatched barrier call sites across hosts fail loudly instead
-    of deadlocking); single-process it is a no-op."""
+    of deadlocking); single-process it is a no-op. Backends without
+    multi-process device collectives (the CPU dryrun harness) fall back to
+    the coordination service's process barrier — same rendezvous guarantee,
+    no device work. The fallback is CPU-only: on real accelerators every
+    device-barrier failure (including the mismatched-name case the keying
+    exists for) must stay loud, not be silently rerouted."""
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+    try:
+        multihost_utils.sync_global_devices(name)
+    except Exception:
+        client = (
+            _coordination_client() if jax.default_backend() == "cpu" else None
+        )
+        if client is None:
+            raise
+        client.wait_at_barrier(
+            f"bench_{name}", timeout_in_ms=int(PREEMPT_ACK_TIMEOUT_SEC * 1000)
+        )
+
+
+# ---------------------------------------------------------------------------
+# Cross-host "preempt soon" broadcast (elastic-resilience round)
+# ---------------------------------------------------------------------------
+#
+# PR 5's PreemptionGuard made a SIGTERM on *rank 0* survivable; on any other
+# host the flag stayed host-local and the run died without a checkpoint. The
+# broadcast below rides the jax.distributed coordination service's key-value
+# store — the same channel that already carries rendezvous heartbeats — so
+# any rank's guard flag becomes visible to every host at its next
+# sync-window boundary:
+#
+#   1. the SIGTERM'd host publishes ``benchpreempt/flag/<rank> = <step>``;
+#   2. every host polls the flag namespace at its fenced boundaries
+#      (``key_value_dir_get`` — non-blocking, ~1 ms host RPC, zero device
+#      work, so the timed windows stay honest);
+#   3. on a visible flag each host publishes its own boundary step as an
+#      ack and gathers everyone else's (blocking, bounded by
+#      PREEMPT_ACK_TIMEOUT_SEC — we are already off the timed path, inside
+#      the SIGTERM grace window);
+#   4. the agreed stop step is ``max(acks)``: hosts behind it keep stepping
+#      to that boundary, so the emergency checkpoint is one *coherent*
+#      collective save at a single step on every host, and every host exits
+#      with the same EXIT_PREEMPTED code.
+#
+# A device all-reduce of the flags would give the same agreement on TPU,
+# but the KV store works identically on backends without multi-process
+# device collectives (the CPU multihost dryrun in the chaos suite) and adds
+# nothing to the device program.
+
+
+def _coordination_client():
+    """The jax.distributed KV-store client, or None outside a rendezvous."""
+    try:
+        from jax._src import distributed as _dist_internal
+
+        return _dist_internal.global_state.client
+    except Exception:
+        return None
+
+
+def publish_preempt_flag(step: int) -> bool:
+    """Announce this host's SIGTERM to every other host (idempotent-ish:
+    callers publish once). Returns False when no channel exists."""
+    client = _coordination_client()
+    if client is None:
+        return False
+    try:
+        client.key_value_set(
+            f"{_PREEMPT_FLAG_PREFIX}{jax.process_index()}", str(int(step))
+        )
+        return True
+    except Exception:
+        return False
+
+
+def preempt_flag_entries() -> List[Tuple[int, int]]:
+    """Non-blocking poll: [(rank, step), ...] of published preempt flags."""
+    client = _coordination_client()
+    if client is None:
+        return []
+    try:
+        entries = client.key_value_dir_get(_PREEMPT_FLAG_PREFIX)
+    except Exception:
+        return []
+    out: List[Tuple[int, int]] = []
+    for key, val in entries:
+        try:
+            out.append((int(key.rsplit("/", 1)[-1]), int(val)))
+        except (ValueError, IndexError):
+            continue
+    return out
+
+
+def agree_preempt_step(
+    my_boundary_step: int, timeout_sec: float = PREEMPT_ACK_TIMEOUT_SEC
+) -> Optional[int]:
+    """Ack my boundary, gather every host's, return the agreed stop step.
+
+    Every host calls this once, at the first fenced boundary where it saw a
+    preempt flag (its own or a peer's); the agreed step is the max of all
+    boundaries, so no host is asked to checkpoint a step it already left
+    behind. Returns None when a peer never acked (died before reaching a
+    boundary) — the caller degrades to a local best-effort stop rather
+    than wedging inside the grace window.
+
+    ``timeout_sec`` is an OVERALL deadline shared across all peers, not a
+    per-peer allowance — two wedged peers must not stack two full
+    timeouts inside the SIGTERM grace window.
+    """
+    client = _coordination_client()
+    if client is None:
+        return my_boundary_step
+    me = jax.process_index()
+    try:
+        client.key_value_set(
+            f"{_PREEMPT_ACK_PREFIX}{me}", str(int(my_boundary_step))
+        )
+    except Exception:
+        return None
+    deadline = time.monotonic() + timeout_sec
+    acks: Dict[int, int] = {me: int(my_boundary_step)}
+    for rank in range(jax.process_count()):
+        if rank in acks:
+            continue
+        remaining_ms = int((deadline - time.monotonic()) * 1000)
+        if remaining_ms <= 0:
+            return None
+        try:
+            val = client.blocking_key_value_get(
+                f"{_PREEMPT_ACK_PREFIX}{rank}", remaining_ms
+            )
+            acks[rank] = int(val)
+        except Exception:
+            return None
+    return max(acks.values())

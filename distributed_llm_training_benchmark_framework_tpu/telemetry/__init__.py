@@ -27,7 +27,9 @@ from .recorder import (  # noqa: F401
     PHASES,
     SCHEMA_VERSION,
     TelemetryRecorder,
+    is_rank_sibling,
     parse_heartbeat_line,
+    rank_telemetry_files,
     read_events,
     telemetry_filename,
 )

@@ -27,6 +27,7 @@ import atexit
 import json
 import math
 import os
+import re
 import sys
 import time
 from typing import Any, Dict, List, Optional
@@ -60,8 +61,50 @@ SPIKE_MIN_HISTORY = 3
 SPIKE_REBASELINE_WINDOWS = 5
 
 
-def telemetry_filename(arm: str) -> str:
+def telemetry_filename(arm: str, rank: int = 0) -> str:
+    """Rank 0 owns the canonical ``telemetry_<arm>.jsonl`` (paired with the
+    result row by slug); every other rank of a multi-host run streams its
+    own ``telemetry_<arm>.rank<r>.jsonl`` beside it — a straggling or
+    preempted non-zero rank is then visible directly instead of only
+    through rank 0's window times (telemetry follow-up (a))."""
+    if rank and rank > 0:
+        return f"telemetry_{arm}.rank{rank}.jsonl"
     return f"telemetry_{arm}.jsonl"
+
+
+#: The rank-sibling suffix contract, in one place: telemetry_filename
+#: builds it, rank_telemetry_files and is_rank_sibling match it.
+_RANK_SIBLING_RE = re.compile(r"\.rank(\d+)\.jsonl$")
+
+
+def is_rank_sibling(path: str) -> bool:
+    """True for a non-zero rank's ``telemetry_<arm>.rank<r>.jsonl`` file
+    (which reports under its rank-0 file, never as a standalone run)."""
+    return _RANK_SIBLING_RE.search(os.path.basename(path)) is not None
+
+
+def rank_telemetry_files(path: str) -> Dict[int, str]:
+    """{rank: path} for a rank-0 telemetry file and its rank siblings.
+
+    ``path`` is the canonical ``telemetry_<arm>.jsonl``; the rank files
+    live beside it. Used by analysis.telemetry_report to merge a
+    multi-host run's per-rank streams into one straggler view.
+    """
+    import glob as _glob
+
+    out: Dict[int, str] = {0: path}
+    base = os.path.basename(path)
+    if not (base.startswith("telemetry_") and base.endswith(".jsonl")):
+        return out
+    stem = base[:-len(".jsonl")]
+    pattern = os.path.join(
+        os.path.dirname(path) or ".", f"{stem}.rank*.jsonl"
+    )
+    for sibling in sorted(_glob.glob(pattern)):
+        m = _RANK_SIBLING_RE.search(sibling)
+        if m:
+            out[int(m.group(1))] = sibling
+    return out
 
 
 def parse_heartbeat_line(line: str) -> Optional[Dict[str, Any]]:
@@ -140,11 +183,13 @@ class TelemetryRecorder:
         heartbeat_every_sec: float = 30.0,
         tokens_per_step: int = 0,
         total_steps: int = 0,
+        rank: int = 0,
         meta: Optional[Dict[str, Any]] = None,
     ):
         self.arm = arm
         self.is_main = is_main
         self.enabled = enabled
+        self.rank = int(rank)
         self.heartbeat_every_sec = heartbeat_every_sec
         self.tokens_per_step = tokens_per_step
         self.total_steps = total_steps
@@ -166,10 +211,17 @@ class TelemetryRecorder:
         self._open_spike: Optional[int] = None  # step that opened the spike
         self._spike_dts: List[float] = []  # window dts while a spike is open
         self.path: Optional[str] = None
-        if enabled and is_main and results_dir:
+        # Rank 0 writes the canonical file; non-zero ranks of a multi-host
+        # run stream their own rank-suffixed sibling (per-rank telemetry —
+        # heartbeats stay rank-0-only below, the stdout scrape channel has
+        # exactly one writer).
+        writes_file = is_main or self.rank > 0
+        if enabled and writes_file and results_dir:
             try:
                 os.makedirs(results_dir, exist_ok=True)
-                self.path = os.path.join(results_dir, telemetry_filename(arm))
+                self.path = os.path.join(
+                    results_dir, telemetry_filename(arm, rank=self.rank)
+                )
                 # buffering=1: line-buffered — each event line reaches the
                 # OS as soon as it is written (the crash-resilience core).
                 self._file = open(self.path, "w", buffering=1)
@@ -220,6 +272,8 @@ class TelemetryRecorder:
 
     def note_resume(
         self, *, step: int, n_restarts: int, baseline_loss: Optional[float] = None,
+        geometry_changed: bool = False,
+        source_geometry: Optional[Dict[str, Any]] = None,
     ) -> None:
         """Record that this run restored a checkpoint and continued.
 
@@ -228,9 +282,13 @@ class TelemetryRecorder:
         the final ``run_end``/``run_aborted`` summary — carries the
         stitch. A resumed run must never be mistakable for a clean
         baseline anywhere downstream (regress registry, partial rows).
+        ``geometry_changed`` marks an elastic (cross-mesh) resume; the
+        source mesh rides the event for the audit trail.
         """
         self.meta["resumed"] = True
         self.meta["n_restarts"] = int(n_restarts)
+        if geometry_changed:
+            self.meta["resume_geometry_changed"] = True
         self._emit(
             "resume", step=step, n_restarts=int(n_restarts),
             baseline_loss=(
@@ -238,6 +296,8 @@ class TelemetryRecorder:
                 if baseline_loss is not None and math.isfinite(baseline_loss)
                 else None
             ),
+            geometry_changed=bool(geometry_changed),
+            source_geometry=source_geometry,
         )
 
     # ------------------------------------------------------------------
@@ -469,6 +529,8 @@ class TelemetryRecorder:
             # the run was not a clean single-attempt measurement.
             fields["resumed"] = True
             fields["n_restarts"] = self.meta.get("n_restarts", 1)
+            if self.meta.get("resume_geometry_changed"):
+                fields["resume_geometry_changed"] = True
         return fields
 
     def discard(self) -> None:

@@ -194,8 +194,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-dir", type=str, default=None)
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="Save every N steps (0 = only final)")
+    p.add_argument("--checkpoint-async", action="store_true",
+                   help="Dispatch periodic saves through orbax's async "
+                        "writer and fence the commit at a later "
+                        "sync-window boundary, so the timed path never "
+                        "blocks on checkpoint IO; a preemption then only "
+                        "FLUSHES the in-flight save (the steps since it "
+                        "are bounded recompute on resume) — "
+                        "docs/FAULT_TOLERANCE.md 'async delta'")
     p.add_argument("--resume", action="store_true",
-                   help="Resume from the latest checkpoint in --checkpoint-dir")
+                   help="Resume from the latest checkpoint in --checkpoint-dir "
+                        "(elastic: a checkpoint saved under a different "
+                        "mesh geometry is reshard-restored, publishing "
+                        "resume_geometry_changed=true)")
     p.add_argument("--debug", action="store_true",
                    help="Fail-fast numerics: NaN checks, tracer-leak checks")
     # Chaos harness (faults/, docs/FAULT_TOLERANCE.md): deterministic
@@ -346,6 +357,7 @@ def main(argv=None) -> int:
             profile_dir=args.profile_dir,
             checkpoint_dir=args.checkpoint_dir,
             checkpoint_every=args.checkpoint_every,
+            checkpoint_async=args.checkpoint_async,
             resume=args.resume,
             telemetry=args.telemetry == "on",
             heartbeat_sec=args.heartbeat_sec,

@@ -100,6 +100,7 @@ def _make_recorder(kwargs: dict) -> TelemetryRecorder:
             heartbeat_every_sec=float(kwargs.get("heartbeat_sec", 30.0)),
             tokens_per_step=step_tokens,
             total_steps=int(kwargs["steps"]),
+            rank=rank,
             meta={
                 "strategy": strategy.name,
                 "world_size": world_size,
@@ -225,6 +226,7 @@ def _run_benchmark_impl(
     profile_dir: Optional[str] = None,
     checkpoint_dir: Optional[str] = None,
     checkpoint_every: int = 0,
+    checkpoint_async: bool = False,
     resume: bool = False,
     telemetry: bool = True,
     heartbeat_sec: float = 30.0,
@@ -255,9 +257,26 @@ def _run_benchmark_impl(
             inject_fault if inject_fault is not None
             else os.environ.get("INJECT_FAULT")
         ),
-        recorder=recorder, is_main=is_main,
+        recorder=recorder, is_main=is_main, rank=rank,
     )
     devices = jax.devices()
+    # Multihost dryrun shape: a jax.distributed rendezvous exists (the
+    # cross-host preempt-soon broadcast rides it) but each host drives its
+    # OWN local mesh — the global device list leads with process 0's
+    # chips, which other ranks cannot address. CPU-backend only (plus a
+    # BENCH_PROCESS_LOCAL=1/0 override): on real accelerators a small
+    # world_size must keep the global list and fail loudly rather than
+    # silently training N independent replicas that publish as one
+    # distributed measurement.
+    _pl = os.environ.get("BENCH_PROCESS_LOCAL", "auto")
+    process_local_world = (
+        jax.process_count() > 1
+        and world_size <= len(jax.local_devices())
+        and (_pl == "1"
+             or (_pl == "auto" and jax.default_backend() == "cpu"))
+    )
+    if process_local_world:
+        devices = jax.local_devices()
     if world_size > len(devices):
         raise ValueError(
             f"world_size={world_size} but only {len(devices)} devices visible"
@@ -538,7 +557,9 @@ def _run_benchmark_impl(
     n_restarts = 0
     resume_step = -1
     resume_baseline_loss = 0.0
+    resume_geometry_changed = False
     if checkpoint_dir:
+        from ..parallel.mesh import mesh_axes_dict
         from ..runtime.checkpoint import BenchmarkCheckpointer
 
         # Tag the PHYSICAL parameter layout: interleaved permutes the stacked
@@ -553,6 +574,15 @@ def _run_benchmark_impl(
                     else "contiguous"
                 ),
             },
+            # Geometry identity for the elastic-resume sidecars: a later
+            # run on a different mesh reshard-restores against its OWN
+            # templates and records the stitch (docs/FAULT_TOLERANCE.md).
+            geometry={
+                "mesh_axes": mesh_axes_dict(mesh),
+                "world_size": world_size,
+            },
+            async_save=checkpoint_async,
+            process_local=process_local_world,
         )
         if resume:
             # restore_latest validates digests newest-first, quarantining
@@ -587,17 +617,25 @@ def _run_benchmark_impl(
                         "longer configuration). Nothing to measure — not "
                         "publishing a zero-step row."
                     )
-                n_restarts = ckpt.note_restart()
+                resume_geometry_changed = ckpt.last_resume_geometry_changed
+                n_restarts = ckpt.note_restart(
+                    geometry_changed=resume_geometry_changed
+                )
                 resume_baseline_loss = float(
                     ckpt.step_meta(resume_step).get("last_loss") or 0.0
                 )
                 recorder.note_resume(
                     step=resume_step, n_restarts=n_restarts,
                     baseline_loss=resume_baseline_loss or None,
+                    geometry_changed=resume_geometry_changed,
+                    source_geometry=ckpt.last_resume_source_geometry,
                 )
                 if is_main:
+                    stitch = (
+                        ", geometry changed" if resume_geometry_changed else ""
+                    )
                     print(f"Resumed from checkpoint at step {resume_step} "
-                          f"(restart #{n_restarts})")
+                          f"(restart #{n_restarts}{stitch})")
             elif is_main:
                 print("Resume requested but no valid checkpoint found — "
                       "cold start")
@@ -657,7 +695,42 @@ def _run_benchmark_impl(
         and raises Preempted — the harness maps it to EXIT_PREEMPTED.
         """
         saved = None
-        if ckpt is not None and at_step >= max(start_step, 0):
+        if (
+            ckpt is not None and ckpt.async_save
+            and at_step >= max(start_step, 0)
+            and (ckpt.pending_async_step() is not None
+                 or ckpt.latest_step() is not None)
+        ):
+            # Async-delta emergency path (docs/FAULT_TOLERANCE.md): the
+            # periodic async saves already streamed (or committed) the
+            # state — only FLUSH the in-flight delta instead of writing a
+            # fresh full checkpoint inside the grace window. The steps
+            # since that save are bounded recompute on resume, recorded
+            # honestly below.
+            recorder.begin_phase("checkpoint")
+            try:
+                flushed = ckpt.finalize_pending()
+                saved = ckpt.latest_step() if flushed is None else flushed
+                recorder.note(
+                    "emergency_flush", mode="async-delta", step=at_step,
+                    committed_step=saved,
+                    steps_delta=(at_step - saved if saved is not None
+                                 else None),
+                )
+                if is_main:
+                    print(f"Emergency flush: async checkpoint at step "
+                          f"{saved} committed (preempted at boundary "
+                          f"{at_step}; {at_step - saved} step(s) of "
+                          "recompute on resume)")
+            except Exception as e:
+                recorder.note("checkpoint_failed", step=at_step,
+                              error=str(e), emergency=True)
+                saved = None
+                if is_main:
+                    print(f"WARNING: emergency async flush at step "
+                          f"{at_step} failed ({e}); aborting as a plain "
+                          "partial")
+        elif ckpt is not None and at_step >= max(start_step, 0):
             if ckpt.latest_step() == at_step:
                 # The periodic save already committed this exact boundary
                 # (orbax refuses same-step overwrites even with force) —
@@ -692,9 +765,12 @@ def _run_benchmark_impl(
         recorder.abort("preempted")
         raise Preempted(at_step, saved)
 
-    if preempt.requested:
+    if preempt.requested and jax.process_count() <= 1:
         # Preempted before the first dispatch (init/compile): nothing new
         # to save, but the abort trail still records the clean reason.
+        # Multi-host runs defer to the first boundary poll instead — the
+        # peers are still compiling, so the cross-host agreement cannot
+        # complete yet (and stopping alone would wedge their collectives).
         _emergency_stop(start_step - 1)
 
     recorder.begin_phase("compile")
@@ -782,7 +858,8 @@ def _run_benchmark_impl(
                 ckpt.save(step, params, opt_state,
                           meta={"last_loss": last_loss_box[0]})
                 if is_main:
-                    print(f"Checkpoint saved at step {step}")
+                    mode = " (async dispatch)" if checkpoint_async else ""
+                    print(f"Checkpoint saved at step {step}{mode}")
                 chaos.after_save(ckpt, step)
             except OSError as e:
                 # A full disk (ENOSPC et al.) must degrade the checkpoint
@@ -798,12 +875,27 @@ def _run_benchmark_impl(
         # Preemption poll — last statement of the body, so a SIGTERM that
         # arrived any time this iteration is acted on at the freshest
         # fenced boundary (and never mid-window: pending must be empty).
-        # The FINAL iteration is exempt: every step has executed by then,
-        # so aborting would trade a complete measurement for a resume
-        # that deterministically refuses — the post-loop branch publishes
-        # instead.
-        if preempt.requested and not pending and step < steps - 1:
-            _emergency_stop(step)
+        # coordinate() makes the poll CROSS-HOST on a jax.distributed
+        # rendezvous: any rank's guard flag is published on the
+        # coordination service, every rank sees it at its next boundary,
+        # and the agreed stop step (max of the ack boundaries) keeps the
+        # emergency checkpoint one coherent collective save — today a
+        # non-zero rank's SIGTERM no longer loses the run. Single-process
+        # runs reduce to the plain flag check. The FINAL iteration still
+        # COORDINATES (a host that skipped its last ack would leave a
+        # late-SIGTERM'd peer blocking out its whole ack timeout inside
+        # the grace window) but never STOPS: every step has executed by
+        # then, so aborting would trade a complete measurement for a
+        # resume that deterministically refuses — the post-loop branch
+        # publishes instead.
+        if not pending:
+            preempt_target = preempt.coordinate(step)
+            if (
+                preempt_target is not None
+                and step >= preempt_target
+                and step < steps - 1
+            ):
+                _emergency_stop(step)
 
     sync_window(t_window)
     if preempt.requested and is_main:
@@ -944,6 +1036,7 @@ def _run_benchmark_impl(
         n_restarts=n_restarts,
         resume_step=resume_step,
         resume_baseline_loss=resume_baseline_loss,
+        resume_geometry_changed=resume_geometry_changed,
         prior_peak_bytes=prior_peak_bytes,
         wall_time_total_sec=recorder.wall_time_total(),
         phase_times=recorder.phase_times(),

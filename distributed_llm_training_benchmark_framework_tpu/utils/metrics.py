@@ -312,6 +312,12 @@ class BenchmarkResult:
     n_restarts: int = 0
     resume_step: int = -1
     resume_baseline_loss: float = 0.0
+    # True when the resume crossed a mesh-geometry change (elastic resume:
+    # the checkpoint was saved under a different dp/tp/sp/pp/ep mesh and
+    # was reshard-restored against this run's PartitionSpecs). Implies
+    # resumed=true (validate_results enforces the coherence); such rows
+    # join plain resumed rows in the regress never-baseline set.
+    resume_geometry_changed: bool = False
     # --- flight-recorder phase attribution (telemetry.TelemetryRecorder,
     # round 8) — where the run's wall time actually went. Measured from
     # recorder start to result computation; the run's telemetry JSONL
@@ -382,6 +388,7 @@ def compute_result(
     n_restarts: int = 0,
     resume_step: int = -1,
     resume_baseline_loss: float = 0.0,
+    resume_geometry_changed: bool = False,
     prior_peak_bytes: Optional[int] = None,
     wall_time_total_sec: float = 0.0,
     phase_times: Optional[Dict[str, float]] = None,
@@ -491,6 +498,7 @@ def compute_result(
         n_restarts=n_restarts,
         resume_step=resume_step,
         resume_baseline_loss=round(resume_baseline_loss, 6),
+        resume_geometry_changed=resume_geometry_changed,
         wall_time_total_sec=round(wall_time_total_sec, 4),
         time_in_init_sec=round(pt.get("init", 0.0), 4),
         time_in_compile_sec=round(pt.get("compile", 0.0), 4),
@@ -548,10 +556,13 @@ def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True)
     if result.n_anomalies > 0:
         print(f"  ANOMALIES:        {result.n_anomalies} (see telemetry JSONL)")
     if result.resumed:
+        stitch = (
+            ", geometry changed" if result.resume_geometry_changed else ""
+        )
         print(
             f"  RESUMED:          from step {result.resume_step} "
-            f"(restart #{result.n_restarts}) — stitched run, never a "
-            "regression baseline"
+            f"(restart #{result.n_restarts}{stitch}) — stitched run, "
+            "never a regression baseline"
         )
     print("=" * 80 + "\n")
 

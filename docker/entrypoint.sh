@@ -88,11 +88,19 @@ export DEBUG="${DEBUG:-0}"
 export INJECT_FAULT="${INJECT_FAULT:-}"
 # In-pod retry loop: 0 (default) keeps the exec'd single-attempt path
 # (python as PID 1 — the preStop/terminationGrace SIGTERM contract).
-# N > 0 supervises the harness from bash, forwarding SIGTERM, and
-# retries a failed run up to N times with RETRY_BACKOFF_SEC backoff —
-# resuming from CHECKPOINT_DIR when one is configured.
+# N > 0 execs scripts/with_retries.sh as PID 1 instead — ONE retry
+# implementation for the whole repo (the former in-entrypoint loop was a
+# deliberate near-duplicate, now folded): it supervises the harness as a
+# background child with a trap-and-forward TERM handler, so kubelet's
+# grace signal still reaches the preemption handler, retries a failed
+# run up to N times with RETRY_BACKOFF_SEC backoff, resumes from
+# CHECKPOINT_DIR when one is configured, and never re-fires an injected
+# chaos fault on its own recovery attempt.
 export MAX_ARM_RETRIES="${MAX_ARM_RETRIES:-0}"
 export RETRY_BACKOFF_SEC="${RETRY_BACKOFF_SEC:-5}"
+# Async delta checkpointing (docs/FAULT_TOLERANCE.md): periodic saves off
+# the timed path; the emergency path only flushes the in-flight delta.
+export CHECKPOINT_ASYNC="${CHECKPOINT_ASYNC:-0}"
 # Flight-recorder telemetry (docs/OBSERVABILITY.md): on by default — the
 # heartbeat markers are what scripts/collect_results.sh scrapes into a
 # partial_<arm>.json when a pod dies before the final result marker.
@@ -182,6 +190,7 @@ if [ "${FLASH_BLOCKWISE_BACKWARD}" = "1" ]; then
   ARGS="${ARGS} --flash-blockwise-backward"; fi
 if [ "${RESUME}" = "1" ]; then ARGS="${ARGS} --resume"; fi
 if [ "${DEBUG}" = "1" ]; then ARGS="${ARGS} --debug"; fi
+if [ "${CHECKPOINT_ASYNC}" = "1" ]; then ARGS="${ARGS} --checkpoint-async"; fi
 if [ -n "${INJECT_FAULT}" ]; then
   ARGS="${ARGS} --inject-fault ${INJECT_FAULT}"; fi
 
@@ -213,54 +222,17 @@ if [ "${MAX_ARM_RETRIES}" = "0" ]; then
   exec python -u /app/benchmarking/train_harness.py ${ARGS}
 fi
 
-# Retry mode: bash stays PID 1, so kubelet's SIGTERM lands HERE — forward
-# it to the harness child or the preemption handler (train/loop.py) never
-# runs and the grace period is wasted. `wait` returns >128 when the trap
-# fires, so re-wait until the child actually exits.
-run_once() {
-  python -u /app/benchmarking/train_harness.py $1 &
-  local child=$!
-  trap 'kill -TERM "$child" 2>/dev/null' TERM
-  local rc=0
-  while :; do
-    wait "$child"; rc=$?
-    kill -0 "$child" 2>/dev/null || break
-  done
-  trap - TERM
-  return "$rc"
-}
-
-# Snapshot the fault spec ONCE: retries strip it from the rebuilt args
-# and clear the env fallback on EVERY attempt > 1 (keying the strip on
-# the live $INJECT_FAULT would stop stripping after attempt 2 cleared
-# it, and the fault would re-arm from the pristine $ARGS on attempt 3).
-FAULT_SPEC="${INJECT_FAULT}"
-attempt=0
-while :; do
-  attempt=$((attempt + 1))
-  RETRY_ARGS="$ARGS"
-  if [ "$attempt" -gt 1 ]; then
-    # Resume, don't cold-restart (when a checkpoint dir exists), and
-    # never re-fire an injected chaos fault on its own recovery attempt.
-    if [ -n "${CHECKPOINT_DIR}" ] && [[ "$RETRY_ARGS" != *" --resume"* ]]; then
-      RETRY_ARGS="$RETRY_ARGS --resume"
-    fi
-    if [ -n "${FAULT_SPEC}" ]; then
-      RETRY_ARGS="${RETRY_ARGS/ --inject-fault ${FAULT_SPEC}/}"
-      export INJECT_FAULT=""
-    fi
-  fi
-  run_once "$RETRY_ARGS"
-  rc=$?
-  [ "$rc" -eq 0 ] && exit 0
-  # 76 = nothing-to-resume (faults.EXIT_NOTHING_TO_RESUME): the refusal
-  # is deterministic — retrying burns the backoff budget for nothing.
-  if [ "$rc" -eq 76 ] || [ "$attempt" -gt "${MAX_ARM_RETRIES}" ]; then
-    exit "$rc"
-  fi
-  backoff=$((RETRY_BACKOFF_SEC * (1 << (attempt - 1))))
-  kind="exit=$rc"
-  [ "$rc" -eq 75 ] && kind="preempted (exit=75)"
-  echo "entrypoint: attempt $attempt failed [$kind]; retrying in ${backoff}s"
-  sleep "$backoff"
-done
+# Retry mode: exec scripts/with_retries.sh as PID 1 — the ONE retry
+# implementation (bounded attempts, exponential backoff, resume-not-
+# cold-restart, injected-fault stripping, and the trap-and-forward TERM
+# handler that keeps kubelet's grace signal reaching the harness child
+# even though bash, not python, is PID 1). Resume only makes sense with
+# a checkpoint dir behind it — --resume without one is a silent no-op in
+# the harness, but passing the flag conditionally keeps retry argvs
+# byte-honest about what they can actually do.
+WRAPPER_FLAGS=(--drop-on-retry --inject-fault)
+if [ -n "${CHECKPOINT_DIR}" ]; then
+  WRAPPER_FLAGS+=(--resume-flag --resume)
+fi
+exec bash /app/scripts/with_retries.sh "${WRAPPER_FLAGS[@]}" -- \
+  python -u /app/benchmarking/train_harness.py ${ARGS}

@@ -15,6 +15,25 @@
 #   chaos_suite.sh                 # full matrix on the tinygpt smoke config
 #   chaos_suite.sh --smoke         # 2-fault smoke (sigkill + torn-checkpoint)
 #   chaos_suite.sh --faults "sigterm hang" --results-dir /tmp/chaos
+#   chaos_suite.sh --elastic       # + geometry-change resume proof
+#                                  #   (save@dp4 -> resume@dp2 -> validated)
+#   chaos_suite.sh --k8s-chaos     # + coordinator-pod-death recovery proof
+#                                  #   (fake kubectl, Indexed Job relaunch)
+#
+# Elastic-resilience arms (docs/FAULT_TOLERANCE.md):
+#   sigterm-rank  (in the full matrix) — the multihost dryrun: two ranks
+#       share a real jax.distributed rendezvous on localhost, each driving
+#       its own local mesh; SIGTERM lands on rank 1 ONLY, and the
+#       cross-host preempt-soon broadcast must stop BOTH ranks coherently
+#       (unanimous exit 75, emergency checkpoints on both, rank 1 visible
+#       in its own telemetry rank file).
+#   elastic       (--elastic, opt-in for --smoke) — a checkpoint saved
+#       under dp4 resumes and trains onward under dp2, publishing
+#       resume_geometry_changed=true and passing validate_results.
+#   k8s-coordinator (--k8s-chaos, opt-in) — the k8s path's own chaos arm:
+#       the coordinator pod dies mid-rendezvous (fake kubectl fails the
+#       first `kubectl wait`), and the suite's Indexed-Job retry loop must
+#       relaunch and recover the arm.
 #
 # Runs on the host CPU by default (the recovery logic is host-level; no
 # slice time is worth burning on it) — set CHAOS_ON_DEVICE=1 to inherit
@@ -24,18 +43,24 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 REPO_ROOT="$(pwd)"
 
-FAULTS="sigkill sigterm nan-loss hang torn-checkpoint enospc-on-save"
+FAULTS="sigkill sigterm sigterm-rank nan-loss hang torn-checkpoint enospc-on-save"
 ROOT=""
 KEEP=0
+ELASTIC=0
+K8S_CHAOS=0
 while [ $# -gt 0 ]; do
   case "$1" in
     --smoke) FAULTS="sigkill torn-checkpoint"; shift ;;
     --faults) FAULTS="$2"; shift 2 ;;
+    --elastic) ELASTIC=1; shift ;;
+    --k8s-chaos) K8S_CHAOS=1; shift ;;
     --results-dir) ROOT="$2"; shift 2 ;;
     --keep) KEEP=1; shift ;;
     *) echo "chaos_suite: unknown flag $1" >&2; exit 2 ;;
   esac
 done
+[ "$ELASTIC" = "1" ] && FAULTS="$FAULTS elastic"
+[ "$K8S_CHAOS" = "1" ] && FAULTS="$FAULTS k8s-coordinator"
 if [ -z "$ROOT" ]; then
   ROOT="$(mktemp -d /tmp/chaos_suite.XXXXXX)"
 else
@@ -176,6 +201,170 @@ for fault in $FAULTS; do
         fail "$fault" "no partial_<arm>.json salvaged"; continue
       fi
       ok "$fault" "hang killed by timeout; classified as a partial row"
+      ;;
+    sigterm-rank)
+      # Multihost dryrun (elastic-resilience round): two harness
+      # processes rendezvous over jax.distributed on localhost; each
+      # drives its own local 1-chip mesh (world_size fits the host, so
+      # the loop selects local devices). The injected SIGTERM hits rank
+      # 1 ONLY; rank 0 must learn of it from the coordination-service
+      # broadcast and still write a coherent emergency checkpoint.
+      port=$((29610 + RANDOM % 200))
+      timeout -k 5 "${CHAOS_MH_TIMEOUT:-180}" \
+        "${HARNESS[@]}" --rank 0 --num-processes 2 \
+        --master-addr 127.0.0.1 --master-port "$port" \
+        --results-dir "$dir/results" \
+        --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+        --inject-fault "sigterm-rank@9:1" > "$dir/rank0.log" 2>&1 &
+      pid0=$!
+      timeout -k 5 "${CHAOS_MH_TIMEOUT:-180}" \
+        "${HARNESS[@]}" --rank 1 --num-processes 2 \
+        --master-addr 127.0.0.1 --master-port "$port" \
+        --results-dir "$dir/results1" \
+        --checkpoint-dir "$dir/ckpt1" --checkpoint-every "$CKPT_EVERY" \
+        --inject-fault "sigterm-rank@9:1" > "$dir/rank1.log" 2>&1 &
+      pid1=$!
+      wait "$pid0"; rc0=$?
+      wait "$pid1"; rc1=$?
+      if [ "$rc0" -ne 75 ] || [ "$rc1" -ne 75 ]; then
+        fail "$fault" "expected unanimous EXIT_PREEMPTED (75/75), got rc0=$rc0 rc1=$rc1"
+        continue
+      fi
+      if ! grep -aq '"event": "run_aborted".*"reason": "preempted"' \
+           "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "rank 0 has no run_aborted reason=preempted trail"; continue
+      fi
+      if ! ls "$dir/ckpt" 2>/dev/null | grep -q '^[0-9]*$'; then
+        fail "$fault" "rank 0 committed no emergency checkpoint"; continue
+      fi
+      if ! grep -aq '"fault": "sigterm-rank@9:1"' \
+           "$dir/results1"/telemetry_*.rank1.jsonl; then
+        fail "$fault" "rank 1's telemetry rank file missing the fault trail"
+        continue
+      fi
+      ok "$fault" "rank-1 SIGTERM stopped BOTH ranks at 75 with checkpoints"
+      ;;
+    elastic)
+      # Geometry-change resume: die under dp4, resume under dp2 — the
+      # resharded row must publish resume_geometry_changed=true and pass
+      # validate_results (fsdp so the params are genuinely resharded,
+      # not just replicated).
+      EHARNESS=(python -u benchmarking/train_harness.py
+                --strategy fsdp --rank 0 --tier S --seq-len 32
+                --steps "$STEPS" --warmup-steps "$WARMUP"
+                --per-device-batch 1 --grad-accum 1 --dataset-size 64
+                --heartbeat-sec 0 --sync-every 2)
+      "${EHARNESS[@]}" --world-size 4 --results-dir "$dir/results" \
+        --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+        --inject-fault "sigkill@9" > "$dir/phase1.log" 2>&1
+      rc=$?
+      if [ "$rc" -eq 0 ]; then fail "$fault" "run survived its own SIGKILL (rc=0)"; continue; fi
+      if ! ls "$dir/ckpt" 2>/dev/null | grep -q '^[0-9]*$'; then
+        fail "$fault" "no dp4 checkpoint committed before the kill"; continue
+      fi
+      if ! "${EHARNESS[@]}" --world-size 2 --results-dir "$dir/results" \
+           --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+           --resume > "$dir/resume.log" 2>&1; then
+        fail "$fault" "dp2 resume did not complete (see $dir/resume.log)"; continue
+      fi
+      if ! grep -q "Elastic resume" "$dir/resume.log"; then
+        fail "$fault" "resume log does not show the reshard restore"; continue
+      fi
+      row="$dir/results/result_fsdp_ws2_seq32_tierS.json"
+      if [ ! -f "$row" ]; then fail "$fault" "no dp2 result row after resume"; continue; fi
+      if ! python - "$row" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["resumed"] is True, f"resumed={r['resumed']}"
+assert r["resume_geometry_changed"] is True, "stitch not recorded"
+assert r["n_restarts"] >= 1, f"n_restarts={r['n_restarts']}"
+assert r["world_size"] == 2, f"world_size={r['world_size']}"
+EOF
+      then fail "$fault" "resharded row missing honest accounting"; continue; fi
+      if ! validate "$dir"; then
+        fail "$fault" "validate_results rejected the resharded resume (see $dir/validate.log)"
+        continue
+      fi
+      ok "$fault" "dp4 checkpoint resumed under dp2; resume_geometry_changed=true validated"
+      ;;
+    k8s-coordinator)
+      # The k8s path's own chaos arm: the coordinator pod (completion
+      # index 0) dies mid-rendezvous, failing the first `kubectl wait`;
+      # run_all_benchmarks.sh's bounded Indexed-Job retry loop must
+      # relaunch and the second attempt recovers a scrapeable result.
+      # Entirely fake kubectl — dryrun-able anywhere, no cluster.
+      bindir="$dir/bin"; mkdir -p "$bindir"
+      cat > "$bindir/kubectl" <<'PYEOF'
+#!/usr/bin/env python3
+"""Stateful fake kubectl: first `wait` fails (coordinator pod died
+mid-rendezvous), later waits succeed; pod logs carry the result markers
+only after a successful wait."""
+import json, os, sys
+argv = sys.argv[1:]
+d = os.environ["FAKE_KUBECTL_DIR"]
+with open(os.path.join(d, "calls.log"), "a") as f:
+    f.write(json.dumps(argv) + "\n")
+def count(name):
+    p = os.path.join(d, name)
+    n = int(open(p).read()) if os.path.exists(p) else 0
+    return n
+def bump(name):
+    n = count(name) + 1
+    with open(os.path.join(d, name), "w") as f:
+        f.write(str(n))
+    return n
+if "apply" in argv:
+    if "-" in argv:
+        sys.stdin.read()
+    print("applied"); sys.exit(0)
+if "wait" in argv:
+    n = bump("wait_count")
+    if n == 1:
+        print("error: job failed: coordinator pod deleted mid-rendezvous",
+              file=sys.stderr)
+        sys.exit(1)
+    sys.exit(0)
+if "get" in argv and "pods" in argv:
+    print("tpu-bench-ddp-ws8-0"); sys.exit(0)
+if "get" in argv and "pod" in argv:
+    print("Succeeded", end=""); sys.exit(0)
+if "logs" in argv:
+    if count("wait_count") < 2:
+        print("jax.distributed rendezvous failed: coordinator unreachable")
+        sys.exit(0)
+    print("boot log line rank=0")
+    result = {
+        "strategy": "ddp", "world_size": 8, "rank": 0, "seq_len": 128,
+        "tier": "S", "steps": 6, "per_device_batch": 1, "grad_accum": 1,
+        "tokens_per_sec": 8000.0, "mean_step_time_sec": 0.128,
+        "mean_loss": 6.0, "peak_vram_gb": 1.0, "h2d_gbps_per_gpu": 1e-5,
+    }
+    print("BENCHMARK_RESULT_JSON_START")
+    print(json.dumps(result, indent=2))
+    print("BENCHMARK_RESULT_JSON_END")
+    sys.exit(0)
+if "delete" in argv:
+    print("deleted"); sys.exit(0)
+sys.exit(0)
+PYEOF
+      chmod +x "$bindir/kubectl"
+      if ! env FAKE_KUBECTL_DIR="$dir" PATH="$bindir:$PATH" \
+           RESULTS_DIR="$dir/results" STRATEGIES="ddp" WORLD_SIZES="8" \
+           COMPOSITIONS=off SKIP_PREFLIGHT=1 SKIP_CHAOS=1 SKIP_REGRESS=1 \
+           MAX_ARM_RETRIES=1 RETRY_BACKOFF_SEC=0 \
+           bash scripts/run_all_benchmarks.sh --k8s > "$dir/phase1.log" 2>&1
+      then
+        fail "$fault" "suite did not recover from the coordinator death (see $dir/phase1.log)"
+        continue
+      fi
+      if [ "$(cat "$dir/wait_count" 2>/dev/null)" != "2" ]; then
+        fail "$fault" "expected exactly one relaunch (2 waits), got $(cat "$dir/wait_count" 2>/dev/null)"
+        continue
+      fi
+      if [ ! -f "$dir/results/tpu-bench-ddp-ws8_results/result.json" ]; then
+        fail "$fault" "no result scraped after the recovery relaunch"; continue
+      fi
+      ok "$fault" "coordinator death -> Indexed Job relaunched -> result recovered"
       ;;
     enospc-on-save)
       run_arm "$dir" "$dir/phase1.log" --inject-fault "enospc-on-save"

@@ -38,6 +38,13 @@ RING_ZIGZAG="auto"
 # grace window is derived inside scripts/liveness_probe.sh (10x, floor
 # 120s), so one knob moves scrape cadence and liveness together.
 HEARTBEAT_SEC="${HEARTBEAT_SEC:-30}"
+# Elastic-resilience checkpointing (docs/FAULT_TOLERANCE.md): empty/0 =
+# off (the default — an emptyDir checkpoint dies with the pod anyway);
+# point CHECKPOINT_DIR at a persistent-volume mount to make relaunches
+# resume, and set CHECKPOINT_ASYNC=1 for the async-delta cadence.
+CHECKPOINT_DIR="${CHECKPOINT_DIR:-}"
+CHECKPOINT_EVERY="${CHECKPOINT_EVERY:-}"
+CHECKPOINT_ASYNC="${CHECKPOINT_ASYNC:-0}"
 # SIGTERM grace (docs/FAULT_TOLERANCE.md): kubelet preemption sends
 # SIGTERM and waits terminationGracePeriodSeconds before SIGKILL. The
 # preemption handler (train/loop.py) acts at the NEXT sync-window
@@ -78,6 +85,9 @@ while [ $# -gt 0 ]; do
     --causal) CAUSAL=1; shift 1 ;;
     --ring-zigzag) RING_ZIGZAG="$2"; shift 2 ;;
     --heartbeat-sec) HEARTBEAT_SEC="$2"; shift 2 ;;
+    --checkpoint-dir) CHECKPOINT_DIR="$2"; shift 2 ;;
+    --checkpoint-every) CHECKPOINT_EVERY="$2"; shift 2 ;;
+    --checkpoint-async) CHECKPOINT_ASYNC=1; shift 1 ;;
     --termination-grace-sec) TERMINATION_GRACE_SEC="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
@@ -138,6 +148,9 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{CAUSAL}}|$CAUSAL|g" \
     -e "s|{{RING_ZIGZAG}}|$RING_ZIGZAG|g" \
     -e "s|{{HEARTBEAT_SEC}}|$HEARTBEAT_SEC|g" \
+    -e "s|{{CHECKPOINT_DIR}}|$CHECKPOINT_DIR|g" \
+    -e "s|{{CHECKPOINT_EVERY}}|$CHECKPOINT_EVERY|g" \
+    -e "s|{{CHECKPOINT_ASYNC}}|$CHECKPOINT_ASYNC|g" \
     -e "s|{{LIVENESS_PERIOD}}|$LIVENESS_PERIOD|g" \
     -e "s|{{TERMINATION_GRACE_SEC}}|$TERMINATION_GRACE_SEC|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \

@@ -167,9 +167,12 @@ if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_PREFLIGHT" != "1" ]; then
 fi
 
 if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_CHAOS" != "1" ]; then
-  echo "=== Chaos smoke: recovery proof (sigkill + torn-checkpoint) ==="
+  echo "=== Chaos smoke: recovery proof (sigkill + torn-checkpoint + elastic) ==="
   CHAOS_DIR=$(mktemp -d /tmp/chaos_smoke.XXXXXX)
-  if scripts/chaos_suite.sh --smoke --results-dir "$CHAOS_DIR"; then
+  # --elastic: the geometry-change resume proof (save@dp4 -> resume@dp2 ->
+  # validate_results passes with resume_geometry_changed=true) rides the
+  # same SKIP_CHAOS=1 hatch as the rest of the smoke.
+  if scripts/chaos_suite.sh --smoke --elastic --results-dir "$CHAOS_DIR"; then
     rm -rf "$CHAOS_DIR"
   else
     echo "CHAOS SMOKE FAILED — the recovery machinery is broken, so a" \

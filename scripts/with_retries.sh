@@ -15,6 +15,15 @@
 # (--inject-fault sigkill@N) from re-firing on every resume; the
 # INJECT_FAULT env var is cleared on retries for the same reason.
 #
+# SIGTERM trap-and-forward (elastic-resilience round): the command runs as
+# a BACKGROUND child with a TERM trap that forwards the signal, so this
+# wrapper is safe as PID 1 — bash-as-PID-1 swallows SIGTERM for itself
+# but the harness child still receives the grace signal and its
+# preemption handler (train/loop.py) gets to emergency-checkpoint. This
+# is what lets docker/entrypoint.sh delegate its retry loop here instead
+# of keeping a near-duplicate. `wait` returns >128 when the trap fires,
+# so re-wait until the child actually exits.
+#
 # Env contract (mirrors the SKIP_* knobs elsewhere in scripts/):
 #   MAX_ARM_RETRIES    retries after the first attempt (default 1; 0 = off)
 #   RETRY_BACKOFF_SEC  base backoff, doubled each retry (default 5)
@@ -46,12 +55,29 @@ if [ $# -eq 0 ]; then
   exit 2
 fi
 
+# Run one attempt with SIGTERM forwarded to the child (see header). The
+# forwarding trap stays installed only for the attempt's lifetime; a TERM
+# arriving between attempts exits the wrapper via the backoff-sleep trap
+# below — there is no child to grace.
+run_attempt() {
+  "$@" &
+  local child=$!
+  trap 'kill -TERM "$child" 2>/dev/null' TERM
+  local rc=0
+  while :; do
+    wait "$child"; rc=$?
+    kill -0 "$child" 2>/dev/null || break
+  done
+  trap - TERM
+  return "$rc"
+}
+
 attempt=0
 rc=0
 while :; do
   attempt=$((attempt + 1))
   if [ "$attempt" -eq 1 ]; then
-    "$@"
+    run_attempt "$@"
     rc=$?
   else
     # Rebuild the argv for a resume attempt: drop the chaos-injection
@@ -67,7 +93,8 @@ while :; do
       RETRY_CMD+=("$tok")
     done
     if [ -n "$RESUME_FLAG" ]; then RETRY_CMD+=("$RESUME_FLAG"); fi
-    INJECT_FAULT="" "${RETRY_CMD[@]}"
+    export INJECT_FAULT=""
+    run_attempt "${RETRY_CMD[@]}"
     rc=$?
   fi
   [ "$rc" -eq 0 ] && exit 0
@@ -81,5 +108,13 @@ while :; do
   echo "with_retries: attempt $attempt failed [$kind]; retrying" \
        "${RESUME_FLAG:+with $RESUME_FLAG }in ${backoff}s" \
        "($((MAX_ARM_RETRIES - attempt + 1)) retr$( [ $((MAX_ARM_RETRIES - attempt + 1)) -eq 1 ] && echo y || echo ies) left)" >&2
-  sleep "$backoff"
+  # Trap TERM through the backoff too: as PID 1 (the entrypoint exec
+  # path) the kernel never delivers default-disposition signals, so a
+  # bare `sleep` would silently SWALLOW kubelet's grace signal and the
+  # pod would relaunch the harness only to be hard-killed at grace
+  # expiry. Sleep in the background so the trap fires immediately.
+  trap 'exit 143' TERM
+  sleep "$backoff" &
+  wait $! || true
+  trap - TERM
 done
