@@ -81,7 +81,7 @@ FLAGSHIP_LAYER_LOOP = "unrolled"
 
 def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
                  layer_loop, attention_impl=None, dropout="inherit",
-                 use_checkpoint=True):
+                 use_checkpoint=True, profile_dir=None):
     """Run one benchmark arm and return its contract-shaped row dict.
 
     Shared by the parity row and the flagship sub-object so the contract
@@ -119,8 +119,22 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
             checkpoint_dir=args.checkpoint_dir if use_checkpoint else None,
             checkpoint_every=args.checkpoint_every if use_checkpoint else 0,
             checkpoint_async=args.checkpoint_async and use_checkpoint,
+            profile_dir=profile_dir,
         )
     per_chip = result.tokens_per_sec / world
+    row_extra = {}
+    if result.comms_exposed_frac is not None:
+        # Step-anatomy secondaries (additive, only when the arm profiled):
+        # these ride into the registry record's result row, where the gate
+        # verdicts comms_exposed_frac beside MFU/peak-HBM
+        # (stats.SECONDARY_METRICS).
+        row_extra = {
+            k: getattr(result, k) for k in (
+                "anatomy_compute_frac", "comms_exposed_frac",
+                "comms_overlap_frac", "anatomy_idle_frac", "bubble_frac",
+                "roofline_flops_pct_of_peak", "roofline_hbm_pct_of_peak",
+            ) if getattr(result, k) is not None
+        }
     return {
         "metric": (
             f"{model_family}_tier{args.tier}_seq{args.seq_len}"
@@ -151,6 +165,7 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
         "time_in_compile_sec": round(result.time_in_compile_sec, 2),
         "time_in_timed_sec": round(result.time_in_timed_sec, 2),
         "n_anomalies": result.n_anomalies,
+        **row_extra,
     }
 
 
@@ -197,6 +212,12 @@ def build_parser():
     # Checkpoint cadence (off by default): measure the checkpoint tax —
     # with --checkpoint-async the periodic saves leave the timed path and
     # time_in_checkpoint_sec shows the saving directly.
+    # Profiler capture for the top-level arm (the flagship sub-run gets a
+    # `<dir>_flagship` sibling): wraps the timed window in jax.profiler,
+    # runs the step-anatomy attribution (analysis/step_anatomy.py) and
+    # rides the compute/exposed-comms/idle + roofline fields into the row
+    # — and so into the registry, where they gate as secondary metrics.
+    p.add_argument("--profile-dir", default=None)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--checkpoint-async", action="store_true",
@@ -238,6 +259,7 @@ def main():
         per_device_batch=args.per_device_batch,
         grad_accum=args.grad_accum,
         layer_loop=args.layer_loop,
+        profile_dir=args.profile_dir,
     )
 
     run_flagship = args.flagship == "on" or (
@@ -262,6 +284,10 @@ def main():
                 # A shared --checkpoint-dir must not mix two arms' states
                 # in one directory; checkpointing belongs to the top row.
                 use_checkpoint=False,
+                # Separate profile dir: two arms' traces in one directory
+                # would make the anatomy/summary run selection ambiguous.
+                profile_dir=(f"{args.profile_dir}_flagship"
+                             if args.profile_dir else None),
             ),
             # Run-identity provenance: exactly which configuration produced
             # the flagship number (the §16 swept geometry).

@@ -147,9 +147,72 @@ def trend_section(registry_root: str, limit: int = 5) -> List[str]:
         return ["## Per-arm trend (registry)", "", f"_unavailable: {e}_", ""]
 
 
+def anatomy_section(df: pd.DataFrame) -> List[str]:
+    """Step-anatomy table for every row that carries the trace-derived
+    attribution (arms run with --profile-dir; analysis/step_anatomy.py).
+
+    The compute / exposed-comms / overlap / idle split plus the roofline
+    position — the report's answer to "is this arm communication-bound,
+    and is the communication hidden".
+    """
+    if "comms_exposed_frac" not in df.columns:
+        return []
+    rows = df[df["comms_exposed_frac"].notna()]
+    if not len(rows):
+        return []
+    out = [
+        "## Step anatomy (trace-derived)", "",
+        "Per traced device step: compute vs collective time (exposed on "
+        "the critical path vs overlapped under compute) vs idle/host gap, "
+        "with the roofline position (% of peak FLOP/s and HBM bandwidth) "
+        "and, for pipeline arms, the schedule's bubble fraction "
+        "(`analysis/step_anatomy.py`, docs/OBSERVABILITY.md). The "
+        "compute/exposed/idle columns are fractions OF THE STEP and sum "
+        "to 100%; *overlap %comms* is the fraction OF COLLECTIVE TIME "
+        "hidden under compute (overlapped time is already inside the "
+        "compute column).", "",
+        "| strategy | ws | seq | compute % | exposed comms % "
+        "| overlap %comms | idle % | bubble % | FLOPs %peak | HBM %peak "
+        "| skew % |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+
+    def pct(row, key):
+        v = row.get(key)
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return "-"
+        return f"{100.0 * v:.1f}" if v == v else "-"
+
+    def raw(row, key):
+        v = row.get(key)
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return "-"
+        return f"{v:.1f}" if v == v else "-"
+
+    for _, r in rows.iterrows():
+        out.append(
+            f"| {r['strategy']} | {int(r['world_size'])} "
+            f"| {int(r['seq_len'])} "
+            f"| {pct(r, 'anatomy_compute_frac')} "
+            f"| {pct(r, 'comms_exposed_frac')} "
+            f"| {pct(r, 'comms_overlap_frac')} "
+            f"| {pct(r, 'anatomy_idle_frac')} "
+            f"| {pct(r, 'bubble_frac')} "
+            f"| {raw(r, 'roofline_flops_pct_of_peak')} "
+            f"| {raw(r, 'roofline_hbm_pct_of_peak')} "
+            f"| {raw(r, 'straggler_skew_pct')} |"
+        )
+    out.append("")
+    return out
+
+
 def build_report(
     df: pd.DataFrame, plots_dir: str = "../plots", plots_root: str = "",
-    registry_root: str = "",
+    registry_root: str = "", step_anatomy_txt: str = "",
 ) -> str:
     df = df.copy()
     cols = [
@@ -271,6 +334,15 @@ def build_report(
         )
     out.append("")
 
+    out += anatomy_section(df)
+    if step_anatomy_txt and os.path.exists(step_anatomy_txt):
+        # The suite's per-arm step-anatomy CLI tables (full component
+        # breakdown incl. top collectives), shipped verbatim.
+        body = open(step_anatomy_txt).read().strip()
+        if body:
+            out += ["### Per-arm anatomy tables", "", "```", body, "```",
+                    ""]
+
     if registry_root:
         out += trend_section(registry_root)
 
@@ -303,6 +375,9 @@ def main(argv=None) -> int:
     p.add_argument("--registry", default=None,
                    help="regress registry root: adds the per-arm trend "
                         "section (run-over-run history)")
+    p.add_argument("--step-anatomy", default=None,
+                   help="step_anatomy CLI output file: embedded verbatim "
+                        "under the step-anatomy section")
     args = p.parse_args(argv)
     df = pd.read_csv(args.csv)
     os.makedirs(args.out, exist_ok=True)
@@ -310,7 +385,8 @@ def main(argv=None) -> int:
     plots_root = os.path.normpath(os.path.join(args.out, args.plots_dir))
     with open(path, "w") as f:
         f.write(build_report(df, args.plots_dir, plots_root=plots_root,
-                             registry_root=args.registry or ""))
+                             registry_root=args.registry or "",
+                             step_anatomy_txt=args.step_anatomy or ""))
     print(f"Wrote {path}")
     return 0
 

@@ -186,14 +186,18 @@ def main(argv=None) -> int:
                         "when --profile-dir holds several runs; default: "
                         "newest, with a warning listing the candidates")
     args = p.parse_args(argv)
+    # ERROR lines go to STDERR: a scripted `summary=$(... profile_summary)`
+    # capture must see the failure on the terminal (and in the exit code),
+    # not swallow it into the captured variable.
     try:
         trace = find_trace_file(args.profile_dir, run=args.run)
     except ValueError as e:
-        print(f"ERROR: {e}")
+        print(f"ERROR: {e}", file=sys.stderr)
         return 1
     if trace is None:
         print(f"ERROR: no *.trace.json.gz under {args.profile_dir} "
-              "(did the run include --profile-dir and >= warmup steps?)")
+              "(did the run include --profile-dir and >= warmup steps?)",
+              file=sys.stderr)
         return 1
     print(f"Trace: {trace}")
     print(format_summary(summarize(load_events(trace), args.top), args.top))

@@ -1,0 +1,734 @@
+#!/usr/bin/env python
+"""Step anatomy: trace-derived compute / comms / idle attribution.
+
+``profile_summary`` answers "which ops burned the time"; this engine answers
+the question the plateau-attack directions (ROADMAP direction 2) actually
+need: **how much of each device step is compute, how much is collective time
+— split into the fraction exposed on the critical path vs. overlapped under
+compute — and how much is idle/host gap**, plus where the arm sits on the
+roofline (achieved vs. peak FLOP/s and HBM GB/s, peaks from
+``utils/platform.py``). Exposed-communication fraction and overlap are the
+decisive levers at scale ("Exploring the limits of Concurrency in ML
+Training on Google TPUs"; "Scalable Training of Language Models using JAX
+pjit and TPUv4" — PAPERS.md), and until they are measured, first-class
+metrics, every overlap/reshard PR is flying blind.
+
+Inputs, all already captured by the harness:
+
+- the Chrome-trace export under ``--profile-dir`` (the ``jax.profiler``
+  bracket around the timed window in ``train/loop.py``);
+- ``cost_analysis.json`` beside the trace — FLOPs / bytes accessed of the
+  jitted step, written by the loop from ``compiled.cost_analysis()``
+  (available even on the CPU dryrun) — powers the roofline row;
+- the run's flight-recorder JSONL (``--telemetry``, auto-discovered when a
+  ``telemetry_*.jsonl`` sits inside the profile dir): its ``timed``
+  phase-wall intervals clip the analysis to the timed region, and its
+  ``run_meta`` names the pipeline schedule for the bubble-fraction row.
+
+Decomposition per traced device step (interval arithmetic over the XLA Ops
+lane, clipped to the step's bounds):
+
+- ``compute``   = union length of non-collective op intervals;
+- ``exposed``   = collective-op union length NOT covered by compute;
+- ``overlapped``= collective ∩ compute length (hidden under compute);
+- ``idle``      = step length − union(all ops) (device gaps: host dispatch,
+  pipeline bubbles, stragglers).
+
+``compute + exposed + idle == step`` exactly (overlapped is accounted
+inside compute), so the fractions are additive. ``overlap_frac`` =
+overlapped / total collective time. Per-rank sibling traces
+(``*.rank<r>.trace.json.gz``, or several device pids inside one trace)
+join into a straggler-skew column. For pipeline arms the device-idle
+fraction inside the step IS the schedule's bubble, published per schedule.
+
+CPU-dryrun caveats: the CPU backend's trace has no meaningful device-op
+lanes (and no known peaks), so the engine is exercised hermetically by the
+frozen fixtures under ``tests/fixtures/trace_frozen*/``; on hardware every
+number is measured. ``cost_analysis()`` FLOPs count the GLOBAL module under
+GSPMD — per-chip values divide by ``world_size`` (recorded in the cost
+JSON).
+
+    python -m distributed_llm_training_benchmark_framework_tpu.analysis.step_anatomy \
+        --profile-dir /tmp/prof [--run NAME] [--telemetry telemetry_<arm>.jsonl] \
+        [--cost-json cost_analysis.json] [--pipeline-schedule gpipe] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import profile_summary as ps
+
+COST_JSON_FILENAME = "cost_analysis.json"
+
+#: XLA collective-op name patterns. Substring match on the op/base name for
+#: the unambiguous collective families; ``send``/``recv`` (pipeline
+#: transfers) only as a leading token so e.g. a custom-call mentioning
+#: "sender" cannot misclassify.
+_COLLECTIVE_RE = re.compile(
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute"
+    r"|collective-broadcast|ppermute)",
+    re.IGNORECASE,
+)
+_SENDRECV_RE = re.compile(r"^(send|recv)([-.\d]|$)", re.IGNORECASE)
+
+#: Rank-sibling trace naming, mirroring the telemetry rank-file contract
+#: (telemetry_<arm>.rank<r>.jsonl): <stem>.rank<r>.trace.json.gz.
+_RANK_TRACE_RE = re.compile(r"\.rank(\d+)\.trace\.json\.gz$")
+
+
+def is_collective_op(name: str) -> bool:
+    return bool(_COLLECTIVE_RE.search(name) or _SENDRECV_RE.match(name))
+
+
+# ---------------------------------------------------------------------------
+# Interval arithmetic (all times in trace microseconds)
+# ---------------------------------------------------------------------------
+
+
+def merge_intervals(ivs: Sequence[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Union of (start, end) intervals as a sorted disjoint list."""
+    out: List[Tuple[float, float]] = []
+    for a, b in sorted(iv for iv in ivs if iv[1] > iv[0]):
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1] = (out[-1][0], b)
+        else:
+            out.append((a, b))
+    return out
+
+
+def intervals_length(ivs: Sequence[Tuple[float, float]]) -> float:
+    return sum(b - a for a, b in ivs)
+
+
+def intersect_intervals(
+    a: Sequence[Tuple[float, float]], b: Sequence[Tuple[float, float]]
+) -> List[Tuple[float, float]]:
+    """Intersection of two DISJOINT-SORTED interval lists (two-pointer)."""
+    out: List[Tuple[float, float]] = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            out.append((lo, hi))
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def clip_intervals(
+    ivs: Sequence[Tuple[float, float]], lo: float, hi: float
+) -> List[Tuple[float, float]]:
+    return [(max(a, lo), min(b, hi)) for a, b in ivs if min(b, hi) > max(a, lo)]
+
+
+# ---------------------------------------------------------------------------
+# Trace extraction
+# ---------------------------------------------------------------------------
+
+
+def device_timelines(events: List[dict]) -> Dict[int, Dict[str, Any]]:
+    """{device pid: {"device", "ops": [(name, t0, t1)], "steps": [...]}}.
+
+    Only ``/device:*`` processes count; the host lanes (python, plugin
+    threads) never enter the attribution.
+    """
+    pids, tids = ps._lane_names(events)
+    out: Dict[int, Dict[str, Any]] = {}
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        pid = e.get("pid")
+        pname = pids.get(pid, "")
+        if not pname.startswith("/device:"):
+            continue
+        lane = tids.get((pid, e.get("tid")), "")
+        dev = out.setdefault(pid, {"device": pname, "ops": [], "steps": []})
+        t0 = float(e["ts"])
+        t1 = t0 + float(e["dur"])
+        if lane == "XLA Ops":
+            dev["ops"].append((e["name"], t0, t1))
+        elif lane == "Steps":
+            dev["steps"].append((e["name"], t0, t1))
+    return out
+
+
+def per_step_op_classes(events: List[dict]) -> List[Dict[str, Any]]:
+    """Per traced step: op-class self-time breakdown (first device lane).
+
+    The anomaly↔trace join (``telemetry_report``) compares a spiked step's
+    class times against the median step's to name the class that grew.
+    """
+    devs = device_timelines(events)
+    if not devs:
+        return []
+    dev = devs[sorted(devs)[0]]
+    out: List[Dict[str, Any]] = []
+    for name, t0, t1 in sorted(dev["steps"], key=lambda s: s[1]):
+        classes: collections.Counter = collections.Counter()
+        for op, a, b in dev["ops"]:
+            lo, hi = max(a, t0), min(b, t1)
+            if hi > lo:
+                classes[ps.op_class(op)] += hi - lo
+        out.append({"step": name, "t0": t0, "t1": t1, "classes": classes})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-step decomposition
+# ---------------------------------------------------------------------------
+
+
+def analyze_steps(
+    ops: Sequence[Tuple[str, float, float]],
+    steps: Sequence[Tuple[str, float, float]],
+    clip_wall_us: Optional[Sequence[Tuple[float, float]]] = None,
+) -> List[Dict[str, Any]]:
+    """Decompose each traced step into compute/exposed/overlapped/idle (us).
+
+    ``clip_wall_us`` (the telemetry timed-phase wall intervals, in trace
+    microseconds) drops steps whose midpoint falls outside the timed region
+    — compile/warmup steps must not dilute the attribution.
+    """
+    out: List[Dict[str, Any]] = []
+    for name, t0, t1 in sorted(steps, key=lambda s: s[1]):
+        if clip_wall_us:
+            mid = (t0 + t1) / 2.0
+            if not any(lo <= mid <= hi for lo, hi in clip_wall_us):
+                continue
+        comp_iv: List[Tuple[float, float]] = []
+        coll_iv: List[Tuple[float, float]] = []
+        coll_by_class: collections.Counter = collections.Counter()
+        for op, a, b in ops:
+            lo, hi = max(a, t0), min(b, t1)
+            if hi <= lo:
+                continue
+            if is_collective_op(op):
+                coll_iv.append((lo, hi))
+                coll_by_class[ps.op_class(op)] += hi - lo
+            else:
+                comp_iv.append((lo, hi))
+        comp_u = merge_intervals(comp_iv)
+        coll_u = merge_intervals(coll_iv)
+        busy = merge_intervals(list(comp_u) + list(coll_u))
+        compute = intervals_length(comp_u)
+        coll_total = intervals_length(coll_u)
+        overlapped = intervals_length(intersect_intervals(coll_u, comp_u))
+        exposed = coll_total - overlapped
+        dur = t1 - t0
+        idle = max(dur - intervals_length(busy), 0.0)
+        out.append({
+            "step": name,
+            "dur_us": dur,
+            "compute_us": compute,
+            "exposed_us": exposed,
+            "overlapped_us": overlapped,
+            "idle_us": idle,
+            "coll_by_class": coll_by_class,
+        })
+    return out
+
+
+def _median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    return s[len(s) // 2] if s else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Telemetry join (timed-region clip + run meta)
+# ---------------------------------------------------------------------------
+
+
+def timed_wall_intervals_us(
+    events: Sequence[Dict[str, Any]],
+) -> List[Tuple[float, float]]:
+    """Wall-clock (ts) intervals of the ``timed`` phase, in microseconds.
+
+    The recorder's phase events carry unix ``ts``; the jax Chrome-trace
+    export stamps ``ts`` in microseconds on the same epoch, so the two
+    clocks join directly. A phase left open by a crash closes at the last
+    event's ts.
+    """
+    out: List[Tuple[float, float]] = []
+    open_t: Optional[float] = None
+    last_ts = 0.0
+    for e in events:
+        ts = float(e.get("ts", 0.0) or 0.0)
+        last_ts = max(last_ts, ts)
+        if e.get("event") == "phase_begin" and e.get("phase") == "timed":
+            open_t = ts
+        elif e.get("event") == "phase_end" and e.get("phase") == "timed":
+            if open_t is not None:
+                out.append((open_t * 1e6, ts * 1e6))
+                open_t = None
+    if open_t is not None:
+        out.append((open_t * 1e6, last_ts * 1e6))
+    return out
+
+
+def telemetry_run_meta(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    return next((e for e in events if e.get("event") == "run_meta"), {})
+
+
+# ---------------------------------------------------------------------------
+# Discovery (rank-sibling aware) + cost JSON
+# ---------------------------------------------------------------------------
+
+
+def discover_traces(
+    profile_dir: str, run: Optional[str] = None
+) -> Dict[int, str]:
+    """{rank: trace path} under a profile dir.
+
+    Standard ``plugins/profile/<run>/`` layouts and bare traces both count;
+    ``*.rank<r>.trace.json.gz`` siblings (one per non-zero rank, mirroring
+    the telemetry rank-file convention) key by their rank, everything else
+    is rank 0 (newest wins). ``run`` filters every candidate — rank
+    siblings included, so a multi-run dir cannot mix another run's rank
+    traces into the skew — by run-dir or file name, and a filter that
+    matches NOTHING raises (like ``profile_summary --run``) instead of
+    silently analyzing the wrong run.
+    """
+    cands = sorted(glob.glob(os.path.join(
+        profile_dir, "plugins", "profile", "*", "*.trace.json.gz"
+    ))) + sorted(glob.glob(os.path.join(profile_dir, "*.trace.json.gz")))
+    if run is not None and cands:
+        sel = [
+            f for f in cands
+            if run in os.path.basename(os.path.dirname(f))
+            or run in os.path.basename(f)
+        ]
+        if not sel:
+            raise ValueError(
+                f"--run {run!r} matches none of the "
+                f"{len(cands)} trace(s) under {profile_dir}: "
+                + ", ".join(os.path.basename(f) for f in cands[:8])
+            )
+        cands = sel
+    ranks: Dict[int, str] = {}
+    plain: List[str] = []
+    for f in cands:
+        m = _RANK_TRACE_RE.search(f)
+        if m:
+            ranks.setdefault(int(m.group(1)), f)
+        else:
+            plain.append(f)
+    out: Dict[int, str] = {}
+    if plain:
+        out[0] = max(plain, key=os.path.getmtime)
+    out.update(ranks)
+    return out
+
+
+def cost_from_compiled(
+    compiled, *, device_kind: str = "", world_size: int = 1
+) -> Optional[Dict[str, Any]]:
+    """FLOPs / bytes-accessed of a ``jax.stages.Compiled`` step.
+
+    ``cost_analysis()`` returns a dict on current jax (a one-element list
+    of dicts on older versions); under GSPMD the counts cover the global
+    module, so consumers divide by ``world_size`` for per-chip numbers —
+    both facts recorded in the payload. Returns None when the runtime
+    exposes no cost analysis.
+    """
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    flops = float(ca.get("flops", 0.0) or 0.0)
+    byts = float(
+        ca.get("bytes accessed", ca.get("bytes_accessed", 0.0)) or 0.0
+    )
+    if flops <= 0 and byts <= 0:
+        return None
+    return {
+        "flops": flops,
+        "bytes_accessed": byts,
+        "device_kind": device_kind,
+        "world_size": int(world_size),
+        "scope": "global_module",
+    }
+
+
+def write_cost_json(profile_dir: str, cost: Dict[str, Any]) -> Optional[str]:
+    """Drop ``cost_analysis.json`` beside the trace (best-effort)."""
+    try:
+        path = os.path.join(profile_dir, COST_JSON_FILENAME)
+        with open(path, "w") as f:
+            json.dump(cost, f, indent=2, sort_keys=True)
+            f.write("\n")
+        return path
+    except OSError:
+        return None
+
+
+def load_cost_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        cost = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        return None
+    return cost if isinstance(cost, dict) else None
+
+
+# ---------------------------------------------------------------------------
+# The full analysis
+# ---------------------------------------------------------------------------
+
+
+def analyze_profile_dir(
+    profile_dir: str,
+    *,
+    run: Optional[str] = None,
+    telemetry_path: Optional[str] = None,
+    cost: Optional[Dict[str, Any]] = None,
+    pipeline_schedule: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Trace(s) + optional telemetry/cost -> the step-anatomy report dict.
+
+    Raises ValueError when no trace exists. Auto-discovers
+    ``cost_analysis.json`` and a single ``telemetry_*.jsonl`` inside the
+    profile dir when not given explicitly.
+    """
+    traces = discover_traces(profile_dir, run=run)
+    if not traces:
+        raise ValueError(
+            f"no *.trace.json.gz under {profile_dir} (did the run include "
+            "--profile-dir and >= warmup steps?)"
+        )
+    if cost is None:
+        cost = load_cost_json(os.path.join(profile_dir, COST_JSON_FILENAME))
+    if telemetry_path is None:
+        tcands = sorted(glob.glob(
+            os.path.join(profile_dir, "telemetry_*.jsonl")
+        ))
+        if len(tcands) == 1:
+            telemetry_path = tcands[0]
+
+    clip: Optional[List[Tuple[float, float]]] = None
+    meta: Dict[str, Any] = {}
+    if telemetry_path and os.path.exists(telemetry_path):
+        from ..telemetry import read_events
+
+        try:
+            tev = read_events(telemetry_path)
+        except (OSError, ValueError):
+            tev = []
+        meta = telemetry_run_meta(tev)
+        clip = timed_wall_intervals_us(tev) or None
+
+    if pipeline_schedule is None:
+        if int(meta.get("pipeline_parallel", 1) or 1) > 1:
+            pipeline_schedule = meta.get("pipeline_schedule") or "gpipe"
+
+    per_rank: Dict[int, Dict[str, Any]] = {}
+    clipped = False
+    clip_fallback_lanes = 0
+    for rank, trace in sorted(traces.items()):
+        events = ps.load_events(trace)
+        devs = device_timelines(events)
+        rank_steps: List[Dict[str, Any]] = []
+        dev_medians: List[float] = []
+        for pid in sorted(devs):
+            dev = devs[pid]
+            steps = analyze_steps(dev["ops"], dev["steps"], clip)
+            if clip and not steps:
+                # Clock bases disagree (some exports stamp ts relative to
+                # trace start): clipping would silently drop everything —
+                # fall back to the full trace and say so.
+                steps = analyze_steps(dev["ops"], dev["steps"], None)
+                if steps:
+                    clip_fallback_lanes += 1
+            elif clip and steps:
+                clipped = True
+            if steps:
+                dev_medians.append(_median([s["dur_us"] for s in steps]))
+            rank_steps.extend(steps)
+        per_rank[rank] = {
+            "trace": trace,
+            "n_devices": len(devs),
+            "steps": rank_steps,
+            "device_median_step_us": dev_medians,
+        }
+
+    all_steps = [s for r in per_rank.values() for s in r["steps"]]
+    if not all_steps:
+        raise ValueError(
+            f"trace(s) under {profile_dir} carry no device step lane "
+            "(no 'Steps' thread on a /device: process)"
+        )
+    totals = {
+        k: sum(s[k] for s in all_steps)
+        for k in ("dur_us", "compute_us", "exposed_us", "overlapped_us",
+                  "idle_us")
+    }
+    coll_total = totals["exposed_us"] + totals["overlapped_us"]
+    dur = totals["dur_us"] or 1.0
+    coll_classes: collections.Counter = collections.Counter()
+    for s in all_steps:
+        coll_classes.update(s["coll_by_class"])
+    n_steps = len(all_steps)
+    median_step_us = _median([s["dur_us"] for s in all_steps])
+
+    # Straggler skew across rank/device step medians: how far the slowest
+    # lane's median step sits above the fastest's.
+    medians = [m for r in per_rank.values()
+               for m in r["device_median_step_us"]]
+    skew_pct = (
+        100.0 * (max(medians) - min(medians)) / min(medians)
+        if len(medians) > 1 and min(medians) > 0 else None
+    )
+    if clipped and clip_fallback_lanes:
+        # Mixing clipped lanes with full-trace fallbacks (warmup/compile
+        # steps included) would mint a phantom straggler.
+        skew_pct = None
+
+    agg: Dict[str, Any] = {
+        "n_steps": n_steps,
+        "n_ranks": len(per_rank),
+        "n_devices": sum(r["n_devices"] for r in per_rank.values()),
+        "clipped_to_timed": clipped,
+        # Lanes whose clock base disagreed with the telemetry epoch and
+        # fell back to the full (unclipped) trace. Non-zero alongside
+        # clipped_to_timed means the sample mixes clipped and unclipped
+        # lanes — straggler skew is then unreliable.
+        "clip_fallback_lanes": clip_fallback_lanes,
+        "median_step_us": median_step_us,
+        "mean_step_us": dur / n_steps,
+        "compute_frac": totals["compute_us"] / dur,
+        "comms_exposed_frac": totals["exposed_us"] / dur,
+        "comms_overlapped_frac_of_step": totals["overlapped_us"] / dur,
+        "idle_frac": totals["idle_us"] / dur,
+        # Overlap fraction OF COLLECTIVE TIME: the direction-2b lever.
+        "comms_overlap_frac": (
+            totals["overlapped_us"] / coll_total if coll_total > 0 else None
+        ),
+        "straggler_skew_pct": skew_pct,
+        "top_collectives": coll_classes.most_common(6),
+        "pipeline_schedule": pipeline_schedule,
+        # Device idle inside the step IS the pipeline bubble when the arm
+        # runs a schedule; None for non-pipeline arms.
+        "bubble_frac": (
+            totals["idle_us"] / dur if pipeline_schedule else None
+        ),
+    }
+
+    roofline: Optional[Dict[str, Any]] = None
+    if cost and agg["median_step_us"] > 0:
+        from ..utils import platform as platform_mod
+
+        ws = max(int(cost.get("world_size", 1) or 1), 1)
+        step_sec = agg["median_step_us"] * 1e-6
+        flops_chip = float(cost.get("flops", 0.0) or 0.0) / ws
+        bytes_chip = float(cost.get("bytes_accessed", 0.0) or 0.0) / ws
+        kind = cost.get("device_kind", "") or ""
+        peak_flops = platform_mod.device_peak_flops(kind)
+        peak_bw = platform_mod.device_peak_hbm_gbps(kind)
+        roofline = {
+            "device_kind": kind,
+            "achieved_tflops_per_sec": (
+                flops_chip / step_sec / 1e12 if flops_chip > 0 else None
+            ),
+            "achieved_hbm_gbps": (
+                bytes_chip / step_sec / 1e9 if bytes_chip > 0 else None
+            ),
+            "peak_tflops_per_sec": (
+                peak_flops / 1e12 if peak_flops else None
+            ),
+            "peak_hbm_gbps": peak_bw,
+            "flops_pct_of_peak": (
+                100.0 * flops_chip / step_sec / peak_flops
+                if peak_flops and flops_chip > 0 else None
+            ),
+            "hbm_pct_of_peak": (
+                100.0 * (bytes_chip / step_sec / 1e9) / peak_bw
+                if peak_bw and bytes_chip > 0 else None
+            ),
+        }
+
+    return {
+        "profile_dir": profile_dir,
+        "trace": per_rank[sorted(per_rank)[0]]["trace"],
+        "per_rank": per_rank,
+        "agg": agg,
+        "roofline": roofline,
+        "arm": meta.get("arm"),
+    }
+
+
+def result_fields(report: Dict[str, Any]) -> Dict[str, Any]:
+    """The additive BenchmarkResult fields this report feeds.
+
+    Keys match ``utils.metrics.BenchmarkResult``; values rounded so result
+    rows and registry records stay byte-stable across identical inputs.
+    """
+
+    def r4(v):
+        return round(v, 4) if v is not None else None
+
+    agg = report["agg"]
+    roof = report.get("roofline") or {}
+    return {
+        "anatomy_compute_frac": r4(agg["compute_frac"]),
+        "comms_exposed_frac": r4(agg["comms_exposed_frac"]),
+        "comms_overlap_frac": r4(agg["comms_overlap_frac"]),
+        "anatomy_idle_frac": r4(agg["idle_frac"]),
+        "bubble_frac": r4(agg["bubble_frac"]),
+        "roofline_flops_pct_of_peak": r4(roof.get("flops_pct_of_peak")),
+        "roofline_hbm_pct_of_peak": r4(roof.get("hbm_pct_of_peak")),
+        "straggler_skew_pct": r4(agg["straggler_skew_pct"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    agg = report["agg"]
+    out: List[str] = []
+    arm = f" [{report['arm']}]" if report.get("arm") else ""
+    out.append(f"== Step anatomy: {report['profile_dir']}{arm} ==")
+    out.append(f"  trace: {report['trace']}"
+               + (f" (+{agg['n_ranks'] - 1} rank sibling(s))"
+                  if agg["n_ranks"] > 1 else ""))
+    clip_note = ""
+    if agg["clipped_to_timed"]:
+        clip_note = " [clipped to telemetry timed region]"
+        if agg.get("clip_fallback_lanes"):
+            clip_note = (
+                f" [PARTIALLY clipped: {agg['clip_fallback_lanes']} "
+                "lane(s) fell back to the full trace on a clock-base "
+                "mismatch — skew unreliable]"
+            )
+    out.append(
+        f"  traced steps: {agg['n_steps']} over {agg['n_devices']} "
+        f"device lane(s){clip_note}"
+    )
+    out.append(f"  median step: {agg['median_step_us'] / 1e3:.3f} ms")
+    out.append("")
+    mean_us = agg["mean_step_us"]
+
+    def row(label, frac):
+        return (f"  {label:<18} {frac * mean_us / 1e3:9.3f} ms  "
+                f"{100.0 * frac:5.1f}%")
+
+    out.append(f"  {'component':<18} {'time/step':>12}   frac")
+    out.append(row("compute", agg["compute_frac"]))
+    out.append(row("comms (exposed)", agg["comms_exposed_frac"]))
+    ov = agg["comms_overlap_frac"]
+    out.append(
+        f"  {'comms (overlapped)':<18} "
+        f"{agg['comms_overlapped_frac_of_step'] * mean_us / 1e3:9.3f} ms  "
+        + (f"[overlap_frac {100.0 * ov:.1f}% of collective time]"
+           if ov is not None else "[no collectives traced]")
+    )
+    out.append(row("idle / host gap", agg["idle_frac"]))
+    if agg["top_collectives"]:
+        per_step = agg["n_steps"] or 1
+        tops = ", ".join(
+            f"{name} {dur / per_step / 1e3:.3f} ms"
+            for name, dur in agg["top_collectives"]
+        )
+        out.append("")
+        out.append(f"  top collectives (per step): {tops}")
+    if agg["bubble_frac"] is not None:
+        out.append(
+            f"  bubble fraction ({agg['pipeline_schedule']}): "
+            f"{100.0 * agg['bubble_frac']:.1f}%"
+        )
+    if agg["straggler_skew_pct"] is not None:
+        out.append(
+            f"  straggler skew: {agg['straggler_skew_pct']:.1f}% across "
+            f"{agg['n_ranks']} rank(s) / {agg['n_devices']} device lane(s)"
+        )
+    roof = report.get("roofline")
+    if roof:
+        bits = []
+        if roof["achieved_tflops_per_sec"] is not None:
+            s = f"{roof['achieved_tflops_per_sec']:.2f} TFLOP/s"
+            if roof["flops_pct_of_peak"] is not None:
+                s += (f" = {roof['flops_pct_of_peak']:.1f}% of "
+                      f"{roof['peak_tflops_per_sec']:.0f} peak")
+            bits.append(s)
+        if roof["achieved_hbm_gbps"] is not None:
+            s = f"{roof['achieved_hbm_gbps']:.1f} GB/s HBM"
+            if roof["hbm_pct_of_peak"] is not None:
+                s += (f" = {roof['hbm_pct_of_peak']:.1f}% of "
+                      f"{roof['peak_hbm_gbps']:.0f} GB/s peak")
+            bits.append(s)
+        if bits:
+            out.append(f"  roofline ({roof['device_kind'] or 'unknown'}): "
+                       + "; ".join(bits))
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--profile-dir", required=True,
+                   help="the directory passed to the harness's --profile-dir")
+    p.add_argument("--run", default=None,
+                   help="profile run name filter when the dir holds several")
+    p.add_argument("--telemetry", default=None,
+                   help="the run's telemetry_<arm>.jsonl: clips the "
+                        "analysis to the timed phase and names the "
+                        "pipeline schedule (auto-discovered when a single "
+                        "telemetry_*.jsonl sits inside the profile dir)")
+    p.add_argument("--cost-json", default=None,
+                   help=f"cost-analysis JSON (default: "
+                        f"{COST_JSON_FILENAME} inside the profile dir, "
+                        "written by the harness)")
+    p.add_argument("--pipeline-schedule", default=None,
+                   help="publish the idle fraction as this schedule's "
+                        "bubble (auto from telemetry run_meta when "
+                        "pipeline_parallel > 1)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the result_fields dict as one JSON line "
+                        "instead of the table")
+    args = p.parse_args(argv)
+    cost = None
+    if args.cost_json:
+        cost = load_cost_json(args.cost_json)
+        if cost is None:
+            # An explicit --cost-json that fails to load must not fall
+            # through to the auto-discovered file from some other run.
+            print(f"ERROR: --cost-json {args.cost_json} missing or "
+                  "unreadable", file=sys.stderr)
+            return 1
+    try:
+        report = analyze_profile_dir(
+            args.profile_dir, run=args.run, telemetry_path=args.telemetry,
+            cost=cost, pipeline_schedule=args.pipeline_schedule,
+        )
+    except ValueError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(result_fields(report), sort_keys=True))
+    else:
+        print(format_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -307,6 +307,104 @@ def join_profile(
 
 
 # ---------------------------------------------------------------------------
+# Anomaly <-> trace join (telemetry follow-up (b))
+# ---------------------------------------------------------------------------
+
+
+def _match_traced_step(
+    anomaly: Dict[str, Any],
+    window: Optional[Dict[str, Any]],
+    traced: List[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    """The traced step covering one spike anomaly, or None.
+
+    Two rungs: the trace's step names ARE step numbers on jax exports, so
+    an exact name match wins; otherwise the spike window's wall-clock span
+    (the ``step_window`` event's unix ``ts``, trace ``ts`` in epoch
+    microseconds) catches traces whose step counter restarted.
+    """
+    step = anomaly.get("step")
+    for t in traced:
+        try:
+            if int(t["step"]) == step:
+                return t
+        except (TypeError, ValueError):
+            pass
+    if window is not None and window.get("ts"):
+        n = window.get("steps_in_window", 1) or 1
+        dt = window.get("window_mean_step_time_sec", 0.0) or 0.0
+        hi = float(window["ts"]) * 1e6
+        lo = hi - n * dt * 1e6
+        for t in traced:
+            mid = (t["t0"] + t["t1"]) / 2.0
+            if lo <= mid <= hi:
+                return t
+    return None
+
+
+def join_anomaly_trace(
+    tl: Dict[str, Any], profile_dir: str, run: Optional[str] = None
+) -> Optional[str]:
+    """Name the op class that grew in each spiked step vs the median step.
+
+    Auto-joins the recorder's ``step_time_spike`` anomalies against the
+    profiler trace whenever ``--profile-dir`` covered the spike window:
+    the spiked step's per-op-class self time is compared against the
+    per-class median over the other traced steps, and the class with the
+    largest growth is named — the triage answer ("the all-reduce grew,
+    not the matmuls") that used to require a by-hand trace read. Returns
+    None when the run recorded no spikes.
+    """
+    spikes = [a for a in tl["anomalies"]
+              if a.get("event") == "anomaly"
+              and a.get("kind") == "step_time_spike"]
+    if not spikes:
+        return None
+    from . import step_anatomy as sa
+
+    out = ["== Anomaly <-> trace join =="]
+    traces = sa.discover_traces(profile_dir, run=run)
+    if 0 not in traces:
+        out.append(f"  no trace under {profile_dir} — spikes not joinable")
+        return "\n".join(out)
+    from . import profile_summary as ps
+
+    traced = sa.per_step_op_classes(ps.load_events(traces[0]))
+    if len(traced) < 2:
+        out.append("  trace holds < 2 device steps — no median to compare "
+                   "a spike against")
+        return "\n".join(out)
+    windows_by_step = {w.get("step"): w for w in tl["windows"]}
+    for a in spikes:
+        target = _match_traced_step(a, windows_by_step.get(a.get("step")),
+                                    traced)
+        if target is None:
+            out.append(
+                f"  spike at step {a.get('step')}: outside the traced "
+                "window (the profiler did not cover the spike)"
+            )
+            continue
+        others = [t for t in traced if t is not target]
+        growth: List[tuple] = []
+        for cls, dur in target["classes"].items():
+            meds = sorted(t["classes"].get(cls, 0.0) for t in others)
+            med = meds[len(meds) // 2] if meds else 0.0
+            growth.append((dur - med, med, dur, cls))
+        if not growth:
+            out.append(f"  spike at step {a.get('step')}: traced step has "
+                       "no op self-time to attribute")
+            continue
+        delta, med, dur, cls = max(growth)
+        ratio = f"{dur / med:.1f}x" if med > 0 else "new"
+        out.append(
+            f"  spike at step {a.get('step')}: '{cls}' grew {ratio} vs "
+            f"the median step ({med / 1e3:.2f} ms -> {dur / 1e3:.2f} ms, "
+            f"+{delta / 1e3:.2f} ms)"
+        )
+    return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
 # Cross-run comparison (--compare A.jsonl B.jsonl)
 # ---------------------------------------------------------------------------
 
@@ -322,9 +420,11 @@ def format_compare(rep: Dict[str, Any]) -> str:
     out: List[str] = ["== Telemetry compare =="]
     for tag in ("a", "b"):
         side = rep[tag]
+        masked = (f" masked_windows={side['masked_windows']}"
+                  if side.get("masked_windows") else "")
         out.append(
             f"  {tag.upper()}: arm={side['arm']} wall={side['wall']:.2f}s "
-            f"timed_windows={side['n_timed_windows']}"
+            f"timed_windows={side['n_timed_windows']}{masked}"
         )
     out.append("")
     out.append("== Phase delta (seconds) ==")
@@ -497,6 +597,14 @@ def main(argv=None) -> int:
             print()
             try:
                 print(join_profile(tl, args.profile_dir, run=args.run))
+                # Telemetry follow-up (b): spikes auto-join against the
+                # trace whenever the profile dir covered them.
+                anomaly_join = join_anomaly_trace(
+                    tl, args.profile_dir, run=args.run
+                )
+                if anomaly_join:
+                    print()
+                    print(anomaly_join)
             except ValueError as e:
                 # Bad/ambiguous --run: report and keep going — the JSONL
                 # reports for the remaining files are still wanted.

@@ -128,6 +128,19 @@ PHASE_TIME_FIELDS = (
 )
 PHASE_SUM_REL_TOL = 1.02
 PHASE_SUM_ABS_SLACK_SEC = 0.05
+# Step-anatomy envelope (analysis/step_anatomy.py): the trace-derived
+# fractions are each in [0, 1], and the three ADDITIVE step components
+# (compute + exposed comms + idle) sum to the step — never beyond it
+# (small slack for interval-arithmetic rounding). Roofline positions are
+# percentages of a hardware peak: a value past ~110% means the cost or
+# peak accounting broke, not that the chip beat its spec. Rows without
+# the fields (no --profile-dir, pre-anatomy artifacts) skip the check.
+ANATOMY_FRAC_FIELDS = (
+    "anatomy_compute_frac", "comms_exposed_frac", "comms_overlap_frac",
+    "anatomy_idle_frac", "bubble_frac",
+)
+ANATOMY_COMPONENT_SUM_TOL = 1.02
+ROOFLINE_PCT_MAX = 110.0
 
 
 def _check(ok: bool, label: str, detail: str, failures: List[str]) -> None:
@@ -356,6 +369,42 @@ def validate_result(r: dict, name: str) -> List[str]:
             r.get("n_anomalies", 0) >= 0, name,
             f"n_anomalies={r.get('n_anomalies')} is negative", f,
         )
+
+    # Step-anatomy envelope (ANATOMY_FRAC_FIELDS above).
+    def _finite(key):
+        v = r.get(key)
+        return v if isinstance(v, (int, float)) and v == v else None
+
+    for key in ANATOMY_FRAC_FIELDS:
+        v = _finite(key)
+        if v is not None:
+            _check(
+                -1e-6 <= v <= 1.0 + 1e-6, name,
+                f"{key}={v} outside [0, 1] — the trace decomposition "
+                "broke", f,
+            )
+    components = [_finite(k) for k in (
+        "anatomy_compute_frac", "comms_exposed_frac", "anatomy_idle_frac",
+    )]
+    if all(v is not None for v in components):
+        total = sum(components)
+        _check(
+            total <= ANATOMY_COMPONENT_SUM_TOL, name,
+            f"step-anatomy components sum to {total:.4f} > 1 — compute + "
+            "exposed comms + idle must not exceed the step time", f,
+        )
+    for key in ("roofline_flops_pct_of_peak", "roofline_hbm_pct_of_peak"):
+        v = _finite(key)
+        if v is not None:
+            _check(
+                0.0 <= v <= ROOFLINE_PCT_MAX, name,
+                f"{key}={v} outside [0, {ROOFLINE_PCT_MAX}] — achieved "
+                "past peak means the cost or peak table broke", f,
+            )
+    skew = _finite("straggler_skew_pct")
+    if skew is not None:
+        _check(skew >= 0.0, name,
+               f"straggler_skew_pct={skew} is negative", f)
     return f
 
 

@@ -70,7 +70,16 @@ def compare_pair(
     min_effect_pct: float = stats.DEFAULT_MIN_EFFECT_PCT,
     alpha: float = stats.DEFAULT_ALPHA,
 ) -> Dict[str, Any]:
-    """Compare two records with registry history as the noise floor."""
+    """Compare two records with registry history as the noise floor.
+
+    The report's ``verdict`` is REGRESSION when ANY comparison — primary
+    throughput or a secondary metric (MFU / peak HBM /
+    comms_exposed_frac, ``stats.SECONDARY_METRICS``) — verdicts one;
+    otherwise it is the primary comparison's verdict.
+    ``gate_comparison`` is the comparison the gate line should quote:
+    the first regressed one, so a secondary-only regression fails CI
+    naming ITS metric, not the healthy primary's.
+    """
     arm = cand_rec.get("arm", base_rec.get("arm", "?"))
     metric_name = (cand_rec.get("metric") or {}).get("name", "tokens_per_sec")
     history = reg.history_values(
@@ -78,17 +87,34 @@ def compare_pair(
         exclude_record_id=cand_rec.get("record_id"),
         match_config_of=cand_rec,
     )
+    secondary_history = {
+        key: reg.result_history_values(
+            arm, result_key=key,
+            exclude_record_id=cand_rec.get("record_id"),
+            match_config_of=cand_rec,
+        )
+        for key, _, _, _ in stats.SECONDARY_METRICS
+    }
     comparisons = stats.compare_records(
         base_rec, cand_rec, min_effect_pct=min_effect_pct, alpha=alpha,
-        history=history,
+        history=history, secondary_history=secondary_history,
     )
+    regressed = [c for c in comparisons
+                 if c.verdict == stats.VERDICT_REGRESSION]
+    if regressed:
+        verdict = stats.VERDICT_REGRESSION
+        gate_comparison = regressed[0]
+    else:
+        verdict = (comparisons[0].verdict if comparisons
+                   else stats.VERDICT_INSUFFICIENT)
+        gate_comparison = comparisons[0] if comparisons else None
     return {
         "arm": arm,
         "baseline": base_rec.get("record_id"),
         "candidate": cand_rec.get("record_id"),
         "comparisons": comparisons,
-        "verdict": comparisons[0].verdict if comparisons else
-        stats.VERDICT_INSUFFICIENT,
+        "verdict": verdict,
+        "gate_comparison": gate_comparison,
     }
 
 
@@ -160,7 +186,10 @@ def gate_arm(
     rep = compare_pair(
         reg, base, cand, min_effect_pct=min_effect_pct, alpha=alpha,
     )
-    c = rep["comparisons"][0]
+    # The quoted comparison is the first REGRESSED one (secondary metrics
+    # included — an overlap regression fails CI by name just like a
+    # tokens/sec one), falling back to the primary when nothing regressed.
+    c = rep["gate_comparison"] or rep["comparisons"][0]
     line = (
         f"regress gate: {rep['verdict'].upper()} arm={arm} {c.summary()} "
         f"baseline={rep['baseline']} candidate={rep['candidate']}"
@@ -194,7 +223,7 @@ def verdict_line_for_bench(
         return (f"regress: arm={arm} first record with this configuration "
                 "— no baseline to compare against")
     rep = compare_pair(reg, base, record)
-    c = rep["comparisons"][0]
+    c = rep["gate_comparison"] or rep["comparisons"][0]
     return (
         f"regress: {rep['verdict'].upper()} vs last-good arm={arm} "
         f"{c.summary()} (baseline={base.get('record_id')} from "

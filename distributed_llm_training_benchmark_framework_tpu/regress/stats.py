@@ -70,33 +70,81 @@ VERDICT_IMPROVEMENT = "improvement"
 VERDICT_NEUTRAL = "neutral"
 VERDICT_INSUFFICIENT = "insufficient-data"
 
+#: First-class SECONDARY metrics the gate verdicts beside the primary
+#: (benchreg follow-up (a) / step-anatomy round): each entry names a
+#: result-row key, its direction sign, its minimum-effect floor and its
+#: comparison scale. ``rel`` compares relative deltas (percent of
+#: baseline); ``abs_pp`` compares fractions on an absolute
+#: percentage-point scale — a comms_exposed_frac of 0.00 -> 0.05 is a
+#: 5-point regression, not an undefined relative delta. All scalar-mode:
+#: one value per run, noise floor learned from same-config registry
+#: history (MIN_SCALAR_HISTORY applies, so sparse history reports
+#: insufficient-data instead of minting verdicts).
+SECONDARY_METRICS = (
+    # (result key, higher_is_better, min effect, scale)
+    ("mfu_pct", True, 2.0, "rel"),
+    ("peak_hbm_gb", False, 5.0, "rel"),
+    ("comms_exposed_frac", False, 2.0, "abs_pp"),
+)
+#: Absolute-scale fallback noise floor (percentage points) below 3
+#: same-config history runs.
+DEFAULT_NOISE_FLOOR_PP = 1.0
+
 
 # ---------------------------------------------------------------------------
 # Telemetry extraction
 # ---------------------------------------------------------------------------
 
 
-def timed_windows(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+def timed_windows(
+    events: Sequence[Dict[str, Any]], *, mask_spikes: bool = False,
+) -> List[Dict[str, Any]]:
     """The comparable sample: ``step_window`` events from the timed phase.
 
     Compile/warmup windows are excluded (their times measure XLA, not the
     step); a run that never reached the timed phase yields [] and the
     comparison degrades to scalar mode rather than comparing warmup noise.
+    ``mask_spikes`` additionally drops windows the recorder flagged inside
+    an open ``step_time_spike`` anomaly (see :func:`split_masked_windows`
+    for the count — masking must never be silent).
     """
-    out = []
+    kept, _ = split_masked_windows(events, mask_spikes=mask_spikes)
+    return kept
+
+
+def split_masked_windows(
+    events: Sequence[Dict[str, Any]], *, mask_spikes: bool = True,
+) -> tuple:
+    """Timed windows split into (kept, spike-masked) lists.
+
+    Window-level anomaly masking (benchreg follow-up (c)): a window that
+    ran during an open recorder spike measures the stall, not the code —
+    comparing it verdicts the incident. The masked windows are returned
+    (not dropped on the floor) so every consumer can surface a
+    ``masked_windows`` count beside its verdict.
+    """
+    from ..telemetry import spike_mask_intervals, step_in_spike
+
+    intervals = spike_mask_intervals(list(events)) if mask_spikes else []
+    kept: List[Dict[str, Any]] = []
+    masked: List[Dict[str, Any]] = []
     for e in events:
         if e.get("event") != "step_window" or e.get("phase") != "timed":
             continue
         dt = e.get("window_mean_step_time_sec")
         if dt is None or dt <= 0:
             continue
-        out.append({
+        w = {
             "step": e.get("step"),
             "steps_in_window": e.get("steps_in_window", 1),
             "dt": float(dt),
             "loss": e.get("loss"),
-        })
-    return out
+        }
+        if intervals and step_in_spike(e.get("step"), intervals):
+            masked.append(w)
+        else:
+            kept.append(w)
+    return kept, masked
 
 
 def window_step_times(record: Dict[str, Any]) -> List[float]:
@@ -240,6 +288,21 @@ def noise_floor_pct(values: Sequence[float]) -> float:
     return max(200.0 * cv, 0.0)
 
 
+def noise_floor_abs(values: Sequence[float]) -> float:
+    """Absolute-scale noise floor: 2x the history's standard deviation.
+
+    The percentage-point analogue of :func:`noise_floor_pct` for metrics
+    whose baseline can legitimately be ~0 (comms_exposed_frac) — a
+    relative CV there divides by nothing. Falls back to
+    DEFAULT_NOISE_FLOOR_PP below 3 samples.
+    """
+    x = np.asarray(values, dtype=float)
+    x = x[np.isfinite(x)]
+    if x.size < 3:
+        return DEFAULT_NOISE_FLOOR_PP
+    return max(2.0 * float(np.std(x)), 0.0)
+
+
 # ---------------------------------------------------------------------------
 # Verdicts
 # ---------------------------------------------------------------------------
@@ -263,14 +326,19 @@ class MetricComparison:
     threshold_pct: float
     verdict: str
     note: str = ""
+    #: Unit of delta/CI/threshold: "%" (relative to baseline) or "pp"
+    #: (absolute percentage points — the abs_pp secondary metrics, whose
+    #: baseline can legitimately be 0 so a relative delta is undefined).
+    unit: str = "%"
 
     def summary(self) -> str:
-        ci = (f"CI95=[{self.ci_lo_pct:+.2f}%, {self.ci_hi_pct:+.2f}%]"
+        u = self.unit
+        ci = (f"CI95=[{self.ci_lo_pct:+.2f}{u}, {self.ci_hi_pct:+.2f}{u}]"
               if math.isfinite(self.ci_lo_pct) else "CI95=[n/a]")
         p = f" p={self.p_value:.4g}" if self.p_value is not None else ""
         return (
-            f"metric={self.metric} delta={self.delta_pct:+.2f}% {ci}{p} "
-            f"threshold={self.threshold_pct:.2f}% verdict={self.verdict}"
+            f"metric={self.metric} delta={self.delta_pct:+.2f}{u} {ci}{p} "
+            f"threshold={self.threshold_pct:.2f}{u} verdict={self.verdict}"
             + (f" ({self.note})" if self.note else "")
         )
 
@@ -341,6 +409,7 @@ def compare_scalars(
     metric: str, higher_is_better: bool,
     history: Sequence[float] = (),
     min_effect_pct: float = DEFAULT_MIN_EFFECT_PCT,
+    absolute: bool = False,
 ) -> MetricComparison:
     """Scalar-vs-history comparison for runs without telemetry windows.
 
@@ -349,22 +418,36 @@ def compare_scalars(
     the noise floor around the baseline, and the reported interval is
     the delta +/- that floor. No p-value is claimed — there is no test
     statistic to compute from two scalars.
+
+    ``absolute=True`` compares on the values' own (pre-scaled) absolute
+    scale instead of percent-of-baseline: delta/CI/threshold are then all
+    in the same units as the inputs (the secondary-metric ``abs_pp``
+    entries pre-scale fractions to percentage points), and a zero
+    baseline is a legal value rather than a division hazard.
     """
     history = [v for v in history if v is not None]
-    noise = noise_floor_pct(history)
+    noise = noise_floor_abs(history) if absolute else noise_floor_pct(history)
     threshold = max(min_effect_pct, noise)
-    if base_value is None or cand_value is None or not base_value:
+    unit = "pp" if absolute else "%"
+    missing = base_value is None or cand_value is None
+    if not absolute and not missing and not base_value:
+        missing = True  # relative scale needs a nonzero baseline
+    if missing:
         return MetricComparison(
             metric=metric, higher_is_better=higher_is_better, mode="scalar",
             n_base=1 if base_value is not None else 0,
             n_cand=1 if cand_value is not None else 0,
-            base_mean=float(base_value or "nan"),
-            cand_mean=float(cand_value or "nan"),
+            base_mean=float(base_value if base_value is not None else "nan"),
+            cand_mean=float(cand_value if cand_value is not None else "nan"),
             delta_pct=float("nan"), ci_lo_pct=float("nan"),
             ci_hi_pct=float("nan"), p_value=None, threshold_pct=threshold,
             verdict=VERDICT_INSUFFICIENT, note="missing metric value",
+            unit=unit,
         )
-    delta_pct = 100.0 * (cand_value - base_value) / base_value
+    if absolute:
+        delta_pct = float(cand_value) - float(base_value)
+    else:
+        delta_pct = 100.0 * (cand_value - base_value) / base_value
     ci_lo, ci_hi = delta_pct - noise, delta_pct + noise
     if len(history) < MIN_SCALAR_HISTORY:
         # The delta is still reported (trend/triage value) but an
@@ -380,14 +463,47 @@ def compare_scalars(
         n_base=1, n_cand=1, base_mean=float(base_value),
         cand_mean=float(cand_value), delta_pct=delta_pct,
         ci_lo_pct=ci_lo, ci_hi_pct=ci_hi, p_value=None,
-        threshold_pct=threshold, verdict=verdict,
+        threshold_pct=threshold, verdict=verdict, unit=unit,
         note=(
-            f"scalar mode, noise floor {noise:.2f}% "
+            f"scalar mode, noise floor {noise:.2f}{unit} "
             f"from {len(history)} history runs"
+            + (" (absolute pp scale)" if absolute else "")
             + ("" if len(history) >= MIN_SCALAR_HISTORY else
                f" — need >= {MIN_SCALAR_HISTORY} for a verdict")
         ),
     )
+
+
+def secondary_comparisons(
+    base_rec: Dict[str, Any], cand_rec: Dict[str, Any], *,
+    secondary_history: Optional[Dict[str, Sequence[float]]] = None,
+) -> List[MetricComparison]:
+    """Scalar comparisons for every SECONDARY metric both rows carry.
+
+    Benchreg follow-up (a): MFU, peak HBM and the step-anatomy
+    comms_exposed_frac verdict beside the primary throughput metric, each
+    with its own direction sign, minimum effect and (per-metric,
+    same-config) noise-floor history. Metrics absent from either result
+    row are skipped — old records stay comparable.
+    """
+    out: List[MetricComparison] = []
+    br = base_rec.get("result") or {}
+    cr = cand_rec.get("result") or {}
+    hist = secondary_history or {}
+    for key, hib, min_eff, scale in SECONDARY_METRICS:
+        bv, cv = br.get(key), cr.get(key)
+        if bv is None or cv is None:
+            continue
+        values = [v for v in hist.get(key, ()) if v is not None]
+        if scale == "abs_pp":
+            # Fractions verdict on an absolute percentage-point scale.
+            bv, cv = float(bv) * 100.0, float(cv) * 100.0
+            values = [float(v) * 100.0 for v in values]
+        out.append(compare_scalars(
+            bv, cv, metric=key, higher_is_better=hib, history=values,
+            min_effect_pct=min_eff, absolute=(scale == "abs_pp"),
+        ))
+    return out
 
 
 def compare_records(
@@ -395,14 +511,18 @@ def compare_records(
     min_effect_pct: float = DEFAULT_MIN_EFFECT_PCT,
     alpha: float = DEFAULT_ALPHA,
     history: Sequence[float] = (),
+    secondary_history: Optional[Dict[str, Sequence[float]]] = None,
 ) -> List[MetricComparison]:
     """Compare two registry records; first comparison is the gate metric.
 
     Window mode when both records carry enough timed windows (primary:
     per-window tokens/sec; secondary: step time); scalar mode against
-    registry history otherwise. Partial candidates/baselines are the
-    caller's (``regress.compare``) responsibility to refuse — this
-    function compares whatever it is handed.
+    registry history otherwise. Either way the SECONDARY metric
+    comparisons (MFU / peak HBM / comms_exposed_frac — see
+    :data:`SECONDARY_METRICS`) are appended after the primary ones.
+    Partial candidates/baselines are the caller's (``regress.compare``)
+    responsibility to refuse — this function compares whatever it is
+    handed.
     """
     out: List[MetricComparison] = []
     b_tps = window_tokens_per_sec(base_rec)
@@ -418,15 +538,25 @@ def compare_records(
             metric="window_mean_step_time_sec", higher_is_better=False,
             min_effect_pct=min_effect_pct, alpha=alpha, noise_pct=noise,
         ))
-        return out
-    bm = (base_rec.get("metric") or {})
-    cm = (cand_rec.get("metric") or {})
-    name = cm.get("name") or bm.get("name") or "metric"
-    out.append(compare_scalars(
-        bm.get("value"), cm.get("value"), metric=name,
-        higher_is_better=bool(cm.get("higher_is_better", True)),
-        history=history, min_effect_pct=min_effect_pct,
+    else:
+        bm = (base_rec.get("metric") or {})
+        cm = (cand_rec.get("metric") or {})
+        name = cm.get("name") or bm.get("name") or "metric"
+        out.append(compare_scalars(
+            bm.get("value"), cm.get("value"), metric=name,
+            higher_is_better=bool(cm.get("higher_is_better", True)),
+            history=history, min_effect_pct=min_effect_pct,
+        ))
+    out.extend(secondary_comparisons(
+        base_rec, cand_rec, secondary_history=secondary_history,
     ))
+    # Window-level anomaly masking is never silent: the counts ride the
+    # primary comparison's note (and so its summary()/gate line).
+    masked_b = int(base_rec.get("masked_windows", 0) or 0)
+    masked_c = int(cand_rec.get("masked_windows", 0) or 0)
+    if out and (masked_b or masked_c):
+        extra = f"masked_windows={masked_b}/{masked_c}"
+        out[0].note = f"{out[0].note}, {extra}" if out[0].note else extra
     return out
 
 
@@ -465,7 +595,8 @@ def compare_telemetry(
             "delta_pct": (100.0 * (b - a) / a)
             if (a and b is not None) else None,
         })
-    wa, wb = timed_windows(events_a), timed_windows(events_b)
+    wa, masked_a = split_masked_windows(events_a)
+    wb, masked_b = split_masked_windows(events_b)
     meta_a, meta_b = tla["meta"], tlb["meta"]
     comparisons: List[MetricComparison] = [compare_distributions(
         [w["dt"] for w in wa], [w["dt"] for w in wb],
@@ -480,11 +611,21 @@ def compare_telemetry(
             metric="tokens_per_sec", higher_is_better=True,
             min_effect_pct=min_effect_pct, alpha=alpha,
         ))
+    if masked_a or masked_b:
+        # The masking rides the PRIMARY comparison's note (and so its
+        # summary line / the verdict line) — never silent.
+        extra = f"masked_windows={len(masked_a)}/{len(masked_b)}"
+        comparisons[0].note = (
+            f"{comparisons[0].note}, {extra}" if comparisons[0].note
+            else extra
+        )
     return {
         "a": {"arm": meta_a.get("arm"), "wall": tla["wall"],
-              "n_timed_windows": len(wa)},
+              "n_timed_windows": len(wa),
+              "masked_windows": len(masked_a)},
         "b": {"arm": meta_b.get("arm"), "wall": tlb["wall"],
-              "n_timed_windows": len(wb)},
+              "n_timed_windows": len(wb),
+              "masked_windows": len(masked_b)},
         "phases": phases,
         "comparisons": comparisons,
     }

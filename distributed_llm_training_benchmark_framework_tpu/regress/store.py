@@ -200,7 +200,14 @@ def config_key(record: Dict[str, Any]) -> Tuple:
             # over-weight the warm caches and the first windows).
             "steps", "warmup_steps",
         )
-    ) + (record.get("metric", {}).get("name"),)
+    ) + (
+        record.get("metric", {}).get("name"),
+        # Profiling is methodology too: the trace bracket around the timed
+        # window adds collection overhead, so a PROFILE=1 run must not
+        # gate against (or feed the noise floor of) an unprofiled lineage.
+        # Anatomy fields are non-null exactly when the run profiled.
+        r.get("comms_exposed_frac") is not None,
+    )
 
 
 def make_record(
@@ -212,6 +219,7 @@ def make_record(
     status: str = "ok",
     source: str = "",
     metric: Optional[Dict[str, Any]] = None,
+    masked_windows: int = 0,
 ) -> Dict[str, Any]:
     """Assemble a schema-versioned record payload (not yet ingested).
 
@@ -221,6 +229,10 @@ def make_record(
     telemetry JSONL (``stats.timed_windows``) — empty when the run had
     no telemetry file (bench.py in-process arms, legacy snapshots), in
     which case comparisons fall back to scalar-vs-history mode.
+    ``masked_windows`` counts spike-flagged windows the extraction
+    excluded (additive key, present only when nonzero — and outside the
+    content hash, like ``source``, so masking accounting can never split
+    one measurement into two records).
     """
     if status not in STATUSES:
         raise ValueError(f"unknown record status {status!r} "
@@ -242,6 +254,8 @@ def make_record(
         "tokens_per_step": int(tokens_per_step),
         "env": env_fingerprint(result_row),
     })
+    if masked_windows:
+        payload["masked_windows"] = int(masked_windows)
     payload["record_id"] = record_id_for(payload)
     return payload
 
@@ -489,6 +503,26 @@ class Registry:
         (stitched) rows are skipped too — neither is a clean measurement
         for anything to be judged against (module docstring).
         """
+        for rec in self._eligible(arm, exclude_record_id, match_config_of):
+            return rec
+        return None
+
+    def _eligible(
+        self, arm: str,
+        exclude_record_id: Optional[str],
+        match_config_of: Optional[Dict[str, Any]],
+    ):
+        """Newest-first records eligible as baseline / noise-floor input.
+
+        THE baseline-eligibility filter chain, shared by :meth:`baseline`,
+        :meth:`history_values` and :meth:`result_history_values` so the
+        primary and secondary noise floors can never disagree about which
+        runs count: status ok, unbanked, not resumed — the
+        resume_geometry_changed check is defense in depth for a row whose
+        accounting broke (flag without resumed; docs/FAULT_TOLERANCE.md)
+        — not the candidate itself, and sharing the candidate's
+        :func:`config_key`.
+        """
         want = config_key(match_config_of) if match_config_of else None
         banked = self.banked_ids()
         for rec in reversed(self.records(arm)):
@@ -497,18 +531,13 @@ class Registry:
             if rec.get("record_id") in banked:
                 continue
             res = rec.get("result") or {}
-            # resume_geometry_changed implies resumed, but a row whose
-            # accounting is broken (flag without resumed) must STILL stay
-            # out of the baseline set — defense in depth for the elastic
-            # stitch (docs/FAULT_TOLERANCE.md).
             if res.get("resumed") or res.get("resume_geometry_changed"):
                 continue
             if exclude_record_id and rec.get("record_id") == exclude_record_id:
                 continue
             if want is not None and config_key(rec) != want:
                 continue
-            return rec
-        return None
+            yield rec
 
     def history_values(
         self, arm: str, *, metric_name: str,
@@ -525,25 +554,37 @@ class Registry:
         regressions and resumed rows stay out for the same reason — a
         stitched run's recompile-polluted value is not run-to-run jitter.
         """
-        want = config_key(match_config_of) if match_config_of else None
-        banked = self.banked_ids()
         vals: List[float] = []
-        for rec in reversed(self.records(arm)):
-            if rec.get("status") != "ok":
-                continue
-            if rec.get("record_id") in banked:
-                continue
-            res = rec.get("result") or {}
-            if res.get("resumed") or res.get("resume_geometry_changed"):
-                continue
-            if exclude_record_id and rec.get("record_id") == exclude_record_id:
-                continue
-            if want is not None and config_key(rec) != want:
-                continue
+        for rec in self._eligible(arm, exclude_record_id, match_config_of):
             m = rec.get("metric") or {}
             if m.get("name") != metric_name or m.get("value") is None:
                 continue
             vals.append(float(m["value"]))
+            if len(vals) >= limit:
+                break
+        return list(reversed(vals))
+
+    def result_history_values(
+        self, arm: str, *, result_key: str,
+        exclude_record_id: Optional[str] = None,
+        match_config_of: Optional[Dict[str, Any]] = None, limit: int = 8,
+    ) -> List[float]:
+        """Same-config history of a RESULT-ROW field (secondary metrics).
+
+        The per-metric noise-floor sample behind the secondary-metric
+        gate (``stats.SECONDARY_METRICS``): MFU, peak HBM and the
+        step-anatomy fractions live in the result row rather than the
+        headline ``metric`` slot, so their run-to-run spread is read from
+        there — with exactly the baseline-eligibility filters
+        :meth:`history_values` applies (the shared :meth:`_eligible`
+        chain: ok-only, unbanked, non-resumed, matching config key).
+        """
+        vals: List[float] = []
+        for rec in self._eligible(arm, exclude_record_id, match_config_of):
+            v = (rec.get("result") or {}).get(result_key)
+            if v is None or not isinstance(v, (int, float)):
+                continue
+            vals.append(float(v))
             if len(vals) >= limit:
                 break
         return list(reversed(vals))
@@ -554,20 +595,30 @@ class Registry:
 # ---------------------------------------------------------------------------
 
 
-def _windows_for_result(result_path: str, arm: str) -> Tuple[List[Dict[str, Any]], int]:
-    """Extract timed windows + tokens_per_step from the sibling JSONL."""
+def _windows_for_result(
+    result_path: str, arm: str,
+) -> Tuple[List[Dict[str, Any]], int, int]:
+    """(timed windows, tokens_per_step, n spike-masked) from the sibling JSONL.
+
+    Window-level anomaly masking (benchreg follow-up (c)): windows the
+    recorder flagged inside an open step-time spike are excluded from the
+    stored comparison sample — they measure the stall, not the code — and
+    their count rides the record as ``masked_windows`` so the verdict
+    line can say the masking happened.
+    """
     tpath = os.path.join(os.path.dirname(result_path), f"telemetry_{arm}.jsonl")
     if not os.path.exists(tpath):
-        return [], 0
+        return [], 0, 0
     from ..telemetry import read_events
     from . import stats
 
     try:
         events = read_events(tpath)
     except (OSError, ValueError):
-        return [], 0
+        return [], 0, 0
     meta = next((e for e in events if e.get("event") == "run_meta"), {})
-    return stats.timed_windows(events), int(meta.get("tokens_per_step", 0) or 0)
+    kept, masked = stats.split_masked_windows(events)
+    return kept, int(meta.get("tokens_per_step", 0) or 0), len(masked)
 
 
 def ingest_results_dir(
@@ -605,10 +656,11 @@ def ingest_results_dir(
                 )
             except KeyError:
                 continue
-        windows, tps = _windows_for_result(path, arm)
+        windows, tps, n_masked = _windows_for_result(path, arm)
         rec = make_record(
             arm=arm, result_row=row, windows=windows, tokens_per_step=tps,
             status="ok", source=os.path.relpath(path, results_dir),
+            masked_windows=n_masked,
         )
         if rec["record_id"] in seen:
             continue  # result_<arm>.json + scraped result.json of one run

@@ -31,5 +31,7 @@ from .recorder import (  # noqa: F401
     parse_heartbeat_line,
     rank_telemetry_files,
     read_events,
+    spike_mask_intervals,
+    step_in_spike,
     telemetry_filename,
 )

@@ -107,6 +107,57 @@ def rank_telemetry_files(path: str) -> Dict[int, str]:
     return out
 
 
+def spike_mask_intervals(
+    events: List[Dict[str, Any]],
+) -> List[tuple]:
+    """Step intervals during which a ``step_time_spike`` anomaly was open.
+
+    Returns ``[(open_step, resolve_step | None), ...]`` — a window whose
+    step satisfies ``open_step <= step < resolve_step`` ran while the
+    recorder's spike screen was tripped (the resolving window itself
+    measured back under the threshold and stays unmasked — EXCEPT for a
+    ``rebaselined`` resolution, where the resolving window was still at
+    the elevated level so the interval extends one step past it; ``None``
+    means the spike never resolved, masking to the end of the run). The
+    shared source of truth for window-level anomaly masking:
+    ``regress.stats`` excludes these windows from comparison samples, and
+    the masking is surfaced as a ``masked_windows`` count so it is never
+    silent.
+    """
+    out: List[tuple] = []
+    open_step: Optional[int] = None
+    for e in events:
+        if (
+            e.get("event") == "anomaly"
+            and e.get("kind") == "step_time_spike"
+            and open_step is None
+        ):
+            open_step = e.get("step")
+        elif (
+            e.get("event") == "anomaly_resolved"
+            and e.get("kind") == "step_time_spike"
+            and open_step is not None
+        ):
+            hi = e.get("step")
+            if e.get("rebaselined") and hi is not None:
+                hi = hi + 1
+            out.append((open_step, hi))
+            open_step = None
+    if open_step is not None:
+        out.append((open_step, None))
+    return out
+
+
+def step_in_spike(step: Optional[int], intervals: List[tuple]) -> bool:
+    """True when ``step`` falls inside any open-spike interval."""
+    if step is None:
+        return False
+    for lo, hi in intervals:
+        if lo is not None and step >= lo and (hi is None or step < hi):
+            return True
+    return False
+
+
 def parse_heartbeat_line(line: str) -> Optional[Dict[str, Any]]:
     """Decode one ``BENCHMARK_HEARTBEAT {json}`` stdout line (or None).
 
@@ -442,6 +493,7 @@ class TelemetryRecorder:
                             "anomaly_resolved", kind="step_time_spike",
                             step=last_step,
                             opened_at_step=self._open_spike,
+                            rebaselined=True,
                             detail=(f"rebaselined after "
                                     f"{len(self._spike_dts)} windows at "
                                     "the new level"),

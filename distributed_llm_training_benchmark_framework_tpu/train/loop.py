@@ -945,6 +945,7 @@ def _run_benchmark_impl(
     # guard avoids even that (and any cache-miss recompile) on runtimes
     # whose memory_stats() works.
     compiled_step = None
+    _aot_compile_failed = False
     _alloc_peak = metrics_mod.peak_hbm_bytes()
     if _alloc_peak is None or (
         prior_peak_bytes is not None and _alloc_peak <= prior_peak_bytes
@@ -952,8 +953,51 @@ def _run_benchmark_impl(
         try:
             compiled_step = active_state.aot_compile(params, opt_state, table, 0)
         except Exception as e:  # degrade down the fallback chain, never fail a run
+            _aot_compile_failed = True
             if is_main:
                 print(f"WARNING: step AOT compile for memory accounting failed: {e}")
+
+    # Step-anatomy attribution (analysis/step_anatomy.py, docs/
+    # OBSERVABILITY.md): when this run captured a profiler trace, decompose
+    # the traced device steps into compute / exposed-vs-overlapped
+    # collective / idle time, position the arm on the roofline (the jitted
+    # step's cost_analysis() FLOPs+bytes — available even on the CPU
+    # dryrun — against utils/platform.py peaks), and publish the fractions
+    # as additive result fields. The cost JSON lands beside the trace so
+    # the offline CLI reproduces the same table later. Best-effort: a
+    # trace the engine cannot read degrades with a warning, never fails
+    # the measured run.
+    step_anatomy_fields = None
+    if trace_started and is_main and profile_dir:
+        try:
+            from ..analysis import step_anatomy as anatomy_mod
+
+            cstep = compiled_step
+            if cstep is None and not _aot_compile_failed:
+                # Compile skipped above (allocator peak sufficed) — worth
+                # attempting for the roofline; a compile that already
+                # FAILED above is not worth paying for twice.
+                try:
+                    cstep = active_state.aot_compile(params, opt_state, table, 0)
+                except Exception:
+                    cstep = None
+            cost = None
+            if cstep is not None:
+                cost = anatomy_mod.cost_from_compiled(
+                    cstep, device_kind=devices[0].device_kind,
+                    world_size=world_size,
+                )
+                if cost is not None:
+                    anatomy_mod.write_cost_json(profile_dir, cost)
+            report = anatomy_mod.analyze_profile_dir(
+                profile_dir, telemetry_path=recorder.path, cost=cost,
+                pipeline_schedule=(pipeline_schedule if pp > 1 else None),
+            )
+            step_anatomy_fields = anatomy_mod.result_fields(report)
+            recorder.note("step_anatomy", **step_anatomy_fields)
+            print(anatomy_mod.format_report(report))
+        except Exception as e:
+            print(f"WARNING: step-anatomy attribution skipped: {e}")
 
     # MoE runs: measure the expert-capacity overflow (dropped-assignment
     # fraction) on the trained params with one diagnostic forward — the
@@ -1041,6 +1085,7 @@ def _run_benchmark_impl(
         wall_time_total_sec=recorder.wall_time_total(),
         phase_times=recorder.phase_times(),
         n_anomalies=recorder.n_anomalies,
+        step_anatomy=step_anatomy_fields,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

@@ -336,6 +336,31 @@ class BenchmarkResult:
     # screened over the run's sync windows; validate_results rejects rows
     # whose telemetry shows them unresolved.
     n_anomalies: int = 0
+    # --- step-anatomy attribution (analysis/step_anatomy.py) — the
+    # trace-derived decomposition of the timed device steps, published
+    # only when the run captured a --profile-dir trace (None otherwise /
+    # for pre-anatomy artifacts). The three step components are additive:
+    # anatomy_compute_frac + comms_exposed_frac + anatomy_idle_frac == 1
+    # (overlapped collective time is accounted inside compute;
+    # comms_overlap_frac reports it as a fraction OF collective time).
+    # comms_exposed_frac is a first-class secondary metric in the regress
+    # gate (stats.SECONDARY_METRICS); validate_results envelopes all of
+    # them (fractions in [0,1], components summing to <= 1).
+    anatomy_compute_frac: Optional[float] = None
+    comms_exposed_frac: Optional[float] = None
+    comms_overlap_frac: Optional[float] = None
+    anatomy_idle_frac: Optional[float] = None
+    # Pipeline arms only: the device-idle fraction inside the step IS the
+    # schedule's bubble (ROADMAP direction 3's per-schedule metric).
+    bubble_frac: Optional[float] = None
+    # Roofline position: achieved vs peak FLOP/s and HBM GB/s (peaks from
+    # utils/platform.py; achieved from the jitted step's cost_analysis()
+    # over the traced median step). None on unknown device kinds (CPU).
+    roofline_flops_pct_of_peak: Optional[float] = None
+    roofline_hbm_pct_of_peak: Optional[float] = None
+    # Across rank-sibling traces / device lanes: how far the slowest
+    # lane's median step sits above the fastest's (percent).
+    straggler_skew_pct: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -393,6 +418,7 @@ def compute_result(
     wall_time_total_sec: float = 0.0,
     phase_times: Optional[Dict[str, float]] = None,
     n_anomalies: int = 0,
+    step_anatomy: Optional[Dict[str, Any]] = None,
 ) -> BenchmarkResult:
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
@@ -443,6 +469,23 @@ def compute_result(
     else:
         p50 = p95 = t_max = cv = 0.0
     pt = phase_times or {}
+    # Step-anatomy fields (analysis.step_anatomy.result_fields keys):
+    # unknown keys are refused rather than silently dropped — the engine
+    # and the result schema must not drift apart.
+    anatomy = dict(step_anatomy or {})
+    anatomy_fields = {
+        k: anatomy.pop(k, None) for k in (
+            "anatomy_compute_frac", "comms_exposed_frac",
+            "comms_overlap_frac", "anatomy_idle_frac", "bubble_frac",
+            "roofline_flops_pct_of_peak", "roofline_hbm_pct_of_peak",
+            "straggler_skew_pct",
+        )
+    }
+    if anatomy:
+        raise ValueError(
+            f"unknown step_anatomy keys {sorted(anatomy)} (the engine's "
+            "result_fields and BenchmarkResult must agree)"
+        )
     return BenchmarkResult(
         strategy=strategy,
         world_size=world_size,
@@ -507,6 +550,7 @@ def compute_result(
         time_in_checkpoint_sec=round(pt.get("checkpoint", 0.0), 4),
         time_in_trace_sec=round(pt.get("trace", 0.0), 4),
         n_anomalies=n_anomalies,
+        **anatomy_fields,
     )
 
 
@@ -553,6 +597,19 @@ def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True)
             f" timed {result.time_in_timed_sec:.1f}s,"
             f" checkpoint {result.time_in_checkpoint_sec:.1f}s)"
         )
+    if result.comms_exposed_frac is not None:
+        anatomy = (
+            f"  Step anatomy:     compute "
+            f"{100.0 * (result.anatomy_compute_frac or 0):.1f}% / exposed "
+            f"comms {100.0 * result.comms_exposed_frac:.1f}% / idle "
+            f"{100.0 * (result.anatomy_idle_frac or 0):.1f}%"
+        )
+        if result.comms_overlap_frac is not None:
+            anatomy += (f"  (overlap {100.0 * result.comms_overlap_frac:.1f}%"
+                        " of collective time)")
+        if result.bubble_frac is not None:
+            anatomy += f"  bubble {100.0 * result.bubble_frac:.1f}%"
+        print(anatomy)
     if result.n_anomalies > 0:
         print(f"  ANOMALIES:        {result.n_anomalies} (see telemetry JSONL)")
     if result.resumed:

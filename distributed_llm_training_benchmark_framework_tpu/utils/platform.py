@@ -1,15 +1,23 @@
-"""Platform selection guard.
+"""Platform selection guard + per-device-kind hardware peaks.
 
 Some TPU environments register their platform plugin from ``sitecustomize`` at
 interpreter startup and force ``jax_platforms`` via ``jax.config.update``,
 which silently overrides a user's ``JAX_PLATFORMS`` environment variable. The
 CPU-smoke and virtual-mesh test paths (SURVEY §4) depend on that variable
 working, so every CLI entry point calls :func:`honor_jax_platforms_env` first.
+
+This module is also the one place the roofline peaks live:
+:func:`device_peak_flops` (bf16 FLOP/s, delegating to the spec table in
+``utils.flops``) and :func:`device_peak_hbm_gbps` (HBM bandwidth). The
+step-anatomy engine (``analysis/step_anatomy.py``) positions every traced
+arm against both axes; keeping the tables here means a new device kind is
+added exactly once.
 """
 
 from __future__ import annotations
 
 import os
+from typing import Optional
 
 
 def honor_jax_platforms_env() -> None:
@@ -35,6 +43,44 @@ def honor_jax_platforms_env() -> None:
             clear_backends()
     except Exception:
         pass
+
+
+# HBM bandwidth per chip, GB/s (decimal), public spec-sheet numbers — the
+# roofline's memory axis. Same substring-match convention as the peak-TFLOPs
+# table in utils/flops.py; order matters (more specific names first).
+_PEAK_HBM_GBPS = (
+    ("TPU v6 lite", 1640.0),  # Trillium / v6e
+    ("TPU v6", 1640.0),
+    ("TPU v5 lite", 819.0),  # v5e
+    ("TPU v5e", 819.0),
+    ("TPU v5p", 2765.0),
+    ("TPU v5", 2765.0),
+    ("TPU v4 lite", 614.0),  # v4i
+    ("TPU v4", 1228.0),
+    ("TPU v3", 900.0),
+    ("TPU v2", 700.0),
+)
+
+
+def device_peak_hbm_gbps(device_kind: str) -> Optional[float]:
+    """HBM GB/s peak for a device kind, or None if unknown (e.g. CPU)."""
+    for name, peak in _PEAK_HBM_GBPS:
+        if name.lower() in device_kind.lower():
+            return peak
+    return None
+
+
+def device_peak_flops(device_kind: str) -> Optional[float]:
+    """bf16 peak FLOP/s per chip (the roofline's compute axis), or None.
+
+    Thin unit-converting wrapper over ``utils.flops.device_peak_tflops`` so
+    the spec numbers exist in exactly one table while roofline consumers
+    pull both axes from this module.
+    """
+    from . import flops as flops_mod
+
+    peak_t = flops_mod.device_peak_tflops(device_kind)
+    return peak_t * 1e12 if peak_t is not None else None
 
 
 def allreduce_promotion_disabled(flags: str) -> bool:

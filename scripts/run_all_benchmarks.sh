@@ -114,6 +114,14 @@ SKIP_CHAOS="${SKIP_CHAOS:-0}"
 MAX_ARM_RETRIES="${MAX_ARM_RETRIES:-1}"
 RETRY_BACKOFF_SEC="${RETRY_BACKOFF_SEC:-5}"
 ARM_CHECKPOINT_EVERY="${ARM_CHECKPOINT_EVERY:-auto}"
+# Step anatomy (analysis/step_anatomy.py, docs/OBSERVABILITY.md): PROFILE=1
+# gives every local arm a --profile-dir ($RESULTS_DIR/<name>_profile), so
+# each run's result row carries the trace-derived compute/exposed-comms/
+# idle + roofline attribution. After the matrix, the analysis pass renders
+# the per-arm anatomy table for ANY arm that produced a profile dir —
+# including dirs from earlier or manual runs — into
+# $SUMMARY/step_anatomy.txt and ships it into BENCHMARK_REPORT.md.
+PROFILE="${PROFILE:-0}"
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -207,6 +215,13 @@ run_local() {
   # Bounded retry with resume (with_retries.sh): the checkpoint cadence
   # backs the resume; retries drop any injected chaos fault so a
   # deterministic fault cannot re-fire on its own recovery attempt.
+  local prof_flags=""
+  if [ "$PROFILE" = "1" ]; then
+    # Fresh dir per invocation, like the checkpoint dir below: a stale
+    # trace from last week must not be attributed as this run's anatomy.
+    rm -rf "$RESULTS_DIR/${name}_profile"
+    prof_flags="--profile-dir $RESULTS_DIR/${name}_profile"
+  fi
   local ckpt_flags=""
   if [ "$ARM_CHECKPOINT_EVERY" != "0" ]; then
     # Fresh dir per invocation: the checkpoints only exist to back THIS
@@ -227,7 +242,7 @@ run_local() {
       --per-device-batch "$PER_DEVICE_BATCH" --grad-accum "$GRAD_ACCUM" \
       --sync-every "$SYNC_EVERY" --layer-loop "$LAYER_LOOP" \
       --results-dir "$RESULTS_DIR/${name}_results" \
-      $extra $ckpt_flags \
+      $extra $ckpt_flags $prof_flags \
       > "$log" 2>&1; then
     scripts/collect_results.sh --log "$log" "$RESULTS_DIR/${name}_results" \
       || true
@@ -362,8 +377,36 @@ python -m distributed_llm_training_benchmark_framework_tpu.analysis.parse_metric
   --results-dir "$RESULTS_DIR" --out "$SUMMARY"
 python -m distributed_llm_training_benchmark_framework_tpu.analysis.plot \
   --results "$SUMMARY/metrics.csv" --out "$RESULTS_DIR/plots"
+
+# Step anatomy on every arm that produced a profile dir (see PROFILE
+# above): the attribution tables land in $SUMMARY/step_anatomy.txt and
+# ride into the report. Best-effort per dir — an unreadable trace warns
+# on stderr without failing the suite.
+ANATOMY_TXT="$SUMMARY/step_anatomy.txt"
+mkdir -p "$SUMMARY"
+rm -f "$ANATOMY_TXT"
+for prof in "$RESULTS_DIR"/*_profile; do
+  [ -d "$prof" ] || continue
+  base="${prof%_profile}"
+  tfile=$(ls "${base}_results"/telemetry_*.jsonl 2>/dev/null | head -1 || true)
+  python -m distributed_llm_training_benchmark_framework_tpu.analysis.step_anatomy \
+    --profile-dir "$prof" ${tfile:+--telemetry "$tfile"} \
+    >> "$ANATOMY_TXT" 2>/dev/null \
+    && { echo "" >> "$ANATOMY_TXT"; } \
+    || echo "WARNING: step-anatomy failed for $prof" >&2
+done
+if [ -s "$ANATOMY_TXT" ]; then
+  echo "--- step anatomy ($(grep -c '^== Step anatomy' "$ANATOMY_TXT")" \
+       "profiled arm(s)) -> $ANATOMY_TXT ---"
+  STEP_ANATOMY_FLAG="--step-anatomy $ANATOMY_TXT"
+else
+  rm -f "$ANATOMY_TXT"
+  STEP_ANATOMY_FLAG=""
+fi
+
 python -m distributed_llm_training_benchmark_framework_tpu.analysis.make_report \
-  --csv "$SUMMARY/metrics.csv" --out "$SUMMARY" --plots-dir ../plots
+  --csv "$SUMMARY/metrics.csv" --out "$SUMMARY" --plots-dir ../plots \
+  $STEP_ANATOMY_FLAG
 
 echo ""
 echo "=== Validation (sanity envelopes, results/example_output/README.md) ==="
@@ -388,7 +431,7 @@ if [ "$SKIP_REGRESS" != "1" ]; then
   # registry carries this suite's records.
   python -m distributed_llm_training_benchmark_framework_tpu.analysis.make_report \
     --csv "$SUMMARY/metrics.csv" --out "$SUMMARY" --plots-dir ../plots \
-    --registry "$REGISTRY_DIR" || true
+    --registry "$REGISTRY_DIR" $STEP_ANATOMY_FLAG || true
 fi
 
 echo ""

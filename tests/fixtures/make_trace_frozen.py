@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Regenerate the frozen step-anatomy trace fixtures (deterministic).
+
+The fixtures pin the attribution math of ``analysis/step_anatomy.py``
+bit-for-bit without hardware (tests/test_step_anatomy.py): interval
+overlap (exposed vs overlapped collectives), idle accounting, telemetry
+timed-region clipping, per-rank straggler skew, the roofline against the
+cost JSON, and the pipeline bubble fraction. Run from the repo root:
+
+    python tests/fixtures/make_trace_frozen.py
+
+Everything is integer-microsecond epoch timestamps (exact float64
+arithmetic) and gzip with mtime=0, so regeneration is byte-identical.
+"""
+
+import gzip
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: Trace/telemetry clocks share the unix epoch: T0 in microseconds.
+T0_SEC = 1754200000
+T0 = T0_SEC * 1_000_000
+
+
+def meta(pid, device, tids):
+    ev = [{"ph": "M", "pid": pid, "name": "process_name",
+           "args": {"name": device}}]
+    for tid, name in tids.items():
+        ev.append({"ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+                   "args": {"name": name}})
+    return ev
+
+
+def op(pid, tid, name, ts, dur):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name, "ts": ts,
+            "dur": dur}
+
+
+def write_gz(path, events):
+    raw = json.dumps({"traceEvents": events}).encode()
+    with open(path, "wb") as f:
+        with gzip.GzipFile(fileobj=f, mode="wb", mtime=0) as z:
+            z.write(raw)
+
+
+def write_jsonl(path, lines):
+    with open(path, "w") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+
+
+def rank_trace(step_dur, n_steps=4, with_compile_step=True):
+    """One device: n timed steps of ``step_dur`` us, each decomposed as
+    compute [0,7000], all-reduce [6000,8500], all-gather [8500,9000] —
+    so per step: compute 7000, overlapped 1000, exposed 2000, idle
+    step_dur-9000."""
+    ev = meta(1, "/device:TPU:0", {10: "XLA Ops", 11: "Steps"})
+    ev += meta(2, "/host:CPU", {20: "python"})
+    if with_compile_step:
+        # A pre-timed (compile) step the telemetry clip must drop: all
+        # compute, so an unclipped analysis would shift every fraction.
+        t0 = T0 - 60_000
+        ev.append(op(1, 11, "0", t0, 50_000))
+        ev.append(op(1, 10, "fusion.0", t0, 50_000))
+    for k in range(1, n_steps + 1):
+        t0 = T0 + (k - 1) * step_dur  # back-to-back, no step overlap
+        ev.append(op(1, 11, str(k), t0, step_dur))
+        ev.append(op(1, 10, "fusion.1", t0, 7_000))
+        ev.append(op(1, 10, "all-reduce.5", t0 + 6_000, 2_500))
+        ev.append(op(1, 10, "all-gather.3", t0 + 8_500, 500))
+    # Host noise that must never enter the attribution.
+    ev.append(op(2, 20, "python_dispatch", T0, 500_000))
+    return ev
+
+
+def main():
+    # --- trace_frozen/: 2 ranks, overlap + clip + roofline -------------
+    d = os.path.join(HERE, "trace_frozen")
+    os.makedirs(d, exist_ok=True)
+    write_gz(os.path.join(d, "trace_frozen.trace.json.gz"),
+             rank_trace(10_000))
+    write_gz(os.path.join(d, "trace_frozen.rank1.trace.json.gz"),
+             rank_trace(10_300, with_compile_step=False))
+    # Cost JSON tuned to land EXACT roofline pins at the 10_300 us median
+    # step: flops = 25% of v5e bf16 peak, bytes = 50% of 819 GB/s.
+    write_jsonl(os.path.join(d, "cost_analysis.json"), [])  # truncate
+    with open(os.path.join(d, "cost_analysis.json"), "w") as f:
+        json.dump({
+            "flops": 1.97e14 * 0.0103 * 0.25,        # 507_275_000_000.0
+            "bytes_accessed": 819e9 * 0.0103 * 0.5,  # 4_217_850_000.0
+            "device_kind": "TPU v5 lite",
+            "world_size": 1,
+            "scope": "global_module",
+        }, f, indent=2, sort_keys=True)
+        f.write("\n")
+    write_jsonl(os.path.join(d, "telemetry_anatomy_frozen.jsonl"), [
+        {"event": "run_meta", "ts": float(T0_SEC - 1), "rel": 0.0,
+         "arm": "anatomy_frozen", "schema_version": 1,
+         "tokens_per_step": 1024, "total_steps": 5,
+         "strategy": "zero2", "world_size": 2, "pipeline_parallel": 1},
+        {"event": "phase_begin", "ts": float(T0_SEC), "rel": 1.0,
+         "phase": "timed"},
+        {"event": "phase_end", "ts": T0_SEC + 0.05, "rel": 1.05,
+         "phase": "timed", "dur_sec": 0.05},
+        {"event": "run_end", "ts": T0_SEC + 0.06, "rel": 1.06,
+         "status": "ok", "last_step": 4},
+    ])
+
+    # --- trace_frozen_pipeline/: bubble fraction ----------------------
+    d = os.path.join(HERE, "trace_frozen_pipeline")
+    os.makedirs(d, exist_ok=True)
+    ev = meta(1, "/device:TPU:0", {10: "XLA Ops", 11: "Steps"})
+    for k in range(1, 4):
+        t0 = T0 + (k - 1) * 10_000
+        ev.append(op(1, 11, str(k), t0, 10_000))
+        ev.append(op(1, 10, "fusion.2", t0, 6_000))
+        ev.append(op(1, 10, "send.1", t0 + 6_000, 500))
+        ev.append(op(1, 10, "recv.2", t0 + 6_500, 500))
+    write_gz(os.path.join(d, "trace_pp.trace.json.gz"), ev)
+    write_jsonl(os.path.join(d, "telemetry_pp_frozen.jsonl"), [
+        {"event": "run_meta", "ts": float(T0_SEC - 1), "rel": 0.0,
+         "arm": "pp_frozen", "schema_version": 1, "tokens_per_step": 512,
+         "total_steps": 3, "strategy": "ddp", "world_size": 2,
+         "pipeline_parallel": 2, "pipeline_schedule": "gpipe"},
+        {"event": "phase_begin", "ts": float(T0_SEC), "rel": 1.0,
+         "phase": "timed"},
+        {"event": "phase_end", "ts": T0_SEC + 0.03, "rel": 1.03,
+         "phase": "timed", "dur_sec": 0.03},
+        {"event": "run_end", "ts": T0_SEC + 0.04, "rel": 1.04,
+         "status": "ok", "last_step": 2},
+    ])
+    print("wrote trace_frozen/ and trace_frozen_pipeline/ fixtures")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,10 +58,23 @@ def test_find_and_summarize(tmp_path):
     assert "%fusion.12" in text  # provenance surfaced
 
 
-def test_cli_missing_trace(tmp_path, capsys):
+def test_cli_missing_trace_errors_to_stderr(tmp_path, capsys):
+    """ERROR lines belong on stderr: a scripted `$(...)` capture of the
+    summary must not swallow the failure into the captured variable."""
     rc = ps.main(["--profile-dir", str(tmp_path)])
     assert rc == 1
-    assert "no *.trace.json.gz" in capsys.readouterr().out
+    captured = capsys.readouterr()
+    assert "no *.trace.json.gz" in captured.err
+    assert captured.out == ""
+
+
+def test_cli_bad_run_selector_errors_to_stderr(tmp_path, capsys):
+    make_trace(tmp_path)
+    rc = ps.main(["--profile-dir", str(tmp_path), "--run", "no-such-run"])
+    assert rc == 1
+    captured = capsys.readouterr()
+    assert "ERROR" in captured.err
+    assert captured.out == ""
 
 
 def test_cli_end_to_end(tmp_path, capsys):

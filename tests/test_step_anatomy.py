@@ -1,0 +1,821 @@
+"""Step-anatomy tests: attribution engine, gating, and integrations.
+
+Layers, cheapest first (docs/OBSERVABILITY.md step-anatomy section):
+
+- **interval math + classification units**: merge/intersect/length, the
+  collective-op name classifier (send/recv as leading tokens only);
+- **frozen-fixture pins** (the acceptance contract): on
+  ``tests/fixtures/trace_frozen/`` the engine's decomposition is pinned
+  bit-for-bit — exposed vs overlapped collective time, idle accounting,
+  the telemetry timed-region clip (the compile step drops out), per-rank
+  straggler skew, the roofline against the committed cost JSON — and on
+  ``tests/fixtures/trace_frozen_pipeline/`` the gpipe bubble fraction.
+  Regenerate with ``python tests/fixtures/make_trace_frozen.py``
+  (byte-identical by construction);
+- **CLI**: the table and ``--json`` modes on the frozen fixtures, ERROR
+  lines on stderr;
+- **result plumbing**: compute_result maps the engine's fields onto the
+  additive BenchmarkResult columns (and refuses unknown keys),
+  emit_result prints the anatomy line, validate_results envelopes the
+  fractions, make_report renders the step-anatomy section;
+- **secondary-metric gate** (benchreg follow-up (a)): an injected
+  exposed-comms regression in a registry candidate makes
+  ``regress gate --all`` exit 1 NAMING comms_exposed_frac while the
+  primary tokens/sec stays neutral; MFU regressions gate the same way;
+- **anomaly masking** (benchreg follow-up (c)): spike-flagged windows
+  are excluded from comparison samples with a masked_windows count in
+  the verdict line;
+- **anomaly-trace join** (telemetry follow-up (b)): a step-time spike
+  joins against the profiler trace and names the op class that grew.
+"""
+
+import gzip
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from distributed_llm_training_benchmark_framework_tpu.analysis import (
+    step_anatomy as sa,
+)
+from distributed_llm_training_benchmark_framework_tpu.analysis import (
+    telemetry_report as tr,
+)
+from distributed_llm_training_benchmark_framework_tpu.analysis import (
+    validate_results as vr,
+)
+from distributed_llm_training_benchmark_framework_tpu.regress import (
+    compare as rcompare,
+    stats as rstats,
+    store as rstore,
+)
+from distributed_llm_training_benchmark_framework_tpu.telemetry import (
+    spike_mask_intervals,
+    step_in_spike,
+)
+from distributed_llm_training_benchmark_framework_tpu.utils import (
+    metrics as metrics_mod,
+)
+from distributed_llm_training_benchmark_framework_tpu.utils import (
+    platform as platform_mod,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+TRACE_FROZEN = os.path.join(FIXTURES, "trace_frozen")
+TRACE_FROZEN_PP = os.path.join(FIXTURES, "trace_frozen_pipeline")
+
+#: The frozen fixture's pinned attribution (see make_trace_frozen.py for
+#: the construction: 8 clipped steps over 2 ranks, per step compute
+#: 7000us / overlapped 1000us / exposed 2000us; rank1 steps 3% slower).
+FROZEN_FIELDS = {
+    "anatomy_compute_frac": 0.6897,    # 56000 / 81200
+    "comms_exposed_frac": 0.197,       # 16000 / 81200
+    "comms_overlap_frac": 0.3333,      # 8000 / 24000 of collective time
+    "anatomy_idle_frac": 0.1133,       # 9200 / 81200
+    "bubble_frac": None,               # not a pipeline arm
+    "roofline_flops_pct_of_peak": 25.0,   # cost JSON tuned to exact pins
+    "roofline_hbm_pct_of_peak": 50.0,
+    "straggler_skew_pct": 3.0,         # rank medians 10.0 -> 10.3 ms
+}
+
+
+# ---------------------------------------------------------------------------
+# Interval math + classification units
+# ---------------------------------------------------------------------------
+
+
+def test_interval_algebra():
+    assert sa.merge_intervals([(0, 2), (1, 3), (5, 6)]) == [(0, 3), (5, 6)]
+    assert sa.merge_intervals([(2, 2), (3, 1)]) == []  # empty/inverted drop
+    assert sa.intervals_length([(0, 3), (5, 6)]) == 4
+    assert sa.intersect_intervals([(0, 4), (6, 9)], [(2, 7)]) == [
+        (2, 4), (6, 7)
+    ]
+    assert sa.clip_intervals([(0, 10)], 3, 5) == [(3, 5)]
+    assert sa.clip_intervals([(0, 2)], 3, 5) == []
+
+
+def test_collective_classifier():
+    for name in ("all-reduce.5", "all-gather.3", "reduce-scatter.1",
+                 "all-to-all", "collective-permute.7", "send.1", "recv.2",
+                 "send", "recv-done.3"):
+        assert sa.is_collective_op(name), name
+    # send/recv only as a LEADING token: 'ascend.2' contains 'send'
+    # mid-word and 'recvbuf_compute' continues into an identifier.
+    for name in ("fusion.12", "while.3", "ascend.2", "recvbuf_compute",
+                 "jvp_jit_flash_attention__.3", "copy.1"):
+        assert not sa.is_collective_op(name), name
+
+
+# ---------------------------------------------------------------------------
+# Frozen-fixture pins (acceptance contract)
+# ---------------------------------------------------------------------------
+
+
+def test_frozen_fixture_attribution_pinned():
+    report = sa.analyze_profile_dir(TRACE_FROZEN)
+    assert sa.result_fields(report) == FROZEN_FIELDS
+    agg = report["agg"]
+    assert agg["n_steps"] == 8  # 4 per rank; the compile step clipped out
+    assert agg["n_ranks"] == 2
+    assert agg["clipped_to_timed"] is True
+    assert agg["median_step_us"] == 10300.0
+    # Exposed vs overlapped in absolute time: 2.0ms exposed + 1.0ms
+    # overlapped per step, all-reduce dominating the class table.
+    assert agg["top_collectives"][0][0] == "all-reduce"
+    roof = report["roofline"]
+    assert roof["device_kind"] == "TPU v5 lite"
+    assert roof["achieved_tflops_per_sec"] == pytest.approx(49.25)
+    assert roof["achieved_hbm_gbps"] == pytest.approx(409.5)
+
+
+def test_frozen_fixture_clip_is_load_bearing(tmp_path):
+    """Without the telemetry sibling the compile step dilutes every
+    fraction — proving the timed-region clip actually clips."""
+    import shutil
+
+    d = tmp_path / "prof"
+    shutil.copytree(TRACE_FROZEN, d)
+    os.remove(d / "telemetry_anatomy_frozen.jsonl")
+    report = sa.analyze_profile_dir(str(d))
+    agg = report["agg"]
+    assert agg["clipped_to_timed"] is False
+    assert agg["n_steps"] == 9  # the all-compute 50ms compile step joins
+    assert agg["compute_frac"] > FROZEN_FIELDS["anatomy_compute_frac"]
+    assert (sa.result_fields(report)["comms_exposed_frac"]
+            < FROZEN_FIELDS["comms_exposed_frac"])
+
+
+def test_frozen_pipeline_bubble_pinned():
+    report = sa.analyze_profile_dir(TRACE_FROZEN_PP)
+    fields = sa.result_fields(report)
+    assert fields["bubble_frac"] == 0.3        # 3000us idle / 10000us step
+    assert fields["anatomy_compute_frac"] == 0.6
+    assert fields["comms_exposed_frac"] == 0.1  # send+recv, never hidden
+    assert fields["comms_overlap_frac"] == 0.0
+    assert report["agg"]["pipeline_schedule"] == "gpipe"  # from run_meta
+    assert fields["roofline_flops_pct_of_peak"] is None  # no cost JSON
+
+
+def test_pipeline_schedule_cli_override():
+    report = sa.analyze_profile_dir(
+        TRACE_FROZEN_PP, pipeline_schedule="1f1b"
+    )
+    assert report["agg"]["pipeline_schedule"] == "1f1b"
+    assert report["agg"]["bubble_frac"] == 0.3
+
+
+def test_discover_traces_rank_siblings():
+    traces = sa.discover_traces(TRACE_FROZEN)
+    assert sorted(traces) == [0, 1]
+    assert traces[0].endswith("trace_frozen.trace.json.gz")
+    assert traces[1].endswith("trace_frozen.rank1.trace.json.gz")
+
+
+def test_discover_traces_run_filter_applies_to_ranks_and_refuses_no_match():
+    # The filter covers rank siblings too (same stem here, so both stay)…
+    traces = sa.discover_traces(TRACE_FROZEN, run="trace_frozen")
+    assert sorted(traces) == [0, 1]
+    # …and a filter matching NOTHING raises (naming the candidates)
+    # instead of silently analyzing the wrong run.
+    with pytest.raises(ValueError, match="matches none.*trace_frozen"):
+        sa.discover_traces(TRACE_FROZEN, run="no_such_run")
+
+
+def test_no_trace_raises_and_missing_step_lane_raises(tmp_path):
+    with pytest.raises(ValueError, match="no \\*.trace.json.gz"):
+        sa.analyze_profile_dir(str(tmp_path))
+    with gzip.open(tmp_path / "x.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": []}, f)
+    with pytest.raises(ValueError, match="no device step lane"):
+        sa.analyze_profile_dir(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_table_on_frozen_fixture(capsys):
+    rc = sa.main(["--profile-dir", TRACE_FROZEN])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "== Step anatomy:" in out
+    assert "compute                7.000 ms   69.0%" in out
+    assert "comms (exposed)        2.000 ms   19.7%" in out
+    assert "[overlap_frac 33.3% of collective time]" in out
+    assert "idle / host gap        1.150 ms   11.3%" in out
+    assert "[clipped to telemetry timed region]" in out
+    assert "straggler skew: 3.0% across 2 rank(s)" in out
+    assert "25.0% of 197 peak" in out and "50.0% of 819 GB/s peak" in out
+
+
+def test_cli_bubble_row_on_pipeline_fixture(capsys):
+    rc = sa.main(["--profile-dir", TRACE_FROZEN_PP])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "bubble fraction (gpipe): 30.0%" in out
+
+
+def test_cli_json_mode(capsys):
+    rc = sa.main(["--profile-dir", TRACE_FROZEN, "--json"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out) == FROZEN_FIELDS
+
+
+def test_cli_errors_go_to_stderr(tmp_path, capsys):
+    rc = sa.main(["--profile-dir", str(tmp_path)])
+    assert rc == 1
+    captured = capsys.readouterr()
+    assert captured.out == ""
+    assert "ERROR" in captured.err
+
+
+def test_cli_explicit_cost_json_missing_fails_loudly(tmp_path, capsys):
+    """An explicit --cost-json that fails to load must error out, not
+    silently fall back to the profile dir's auto-discovered file."""
+    rc = sa.main(["--profile-dir", TRACE_FROZEN,
+                  "--cost-json", str(tmp_path / "typo.json")])
+    assert rc == 1
+    captured = capsys.readouterr()
+    assert captured.out == ""
+    assert "ERROR" in captured.err and "typo.json" in captured.err
+
+
+def test_partial_clip_fallback_is_loud_and_voids_skew(tmp_path, capsys):
+    """When one rank's trace clock base disagrees with the telemetry
+    epoch, its lanes fall back to the full trace: the mix is flagged and
+    straggler skew (clipped vs unclipped medians) is voided."""
+    import shutil
+
+    d = tmp_path / "prof"
+    shutil.copytree(TRACE_FROZEN, d)
+    rank1 = d / "trace_frozen.rank1.trace.json.gz"
+    with gzip.open(rank1, "rt") as f:
+        trace = json.load(f)
+    for e in trace.get("traceEvents", []):
+        if "ts" in e:
+            e["ts"] = e["ts"] - 10_000_000_000  # shift out of the clip
+    with gzip.open(rank1, "wt") as f:
+        json.dump(trace, f)
+    report = sa.analyze_profile_dir(str(d))
+    agg = report["agg"]
+    assert agg["clipped_to_timed"] is True
+    assert agg["clip_fallback_lanes"] == 1
+    assert agg["straggler_skew_pct"] is None
+    assert sa.result_fields(report)["straggler_skew_pct"] is None
+    txt = sa.format_report(report)
+    assert "PARTIALLY clipped" in txt and "skew unreliable" in txt
+    assert "straggler skew" not in txt
+
+
+# ---------------------------------------------------------------------------
+# Result plumbing: compute_result / emit_result / validator / report
+# ---------------------------------------------------------------------------
+
+
+def _result(**over):
+    kwargs = dict(
+        strategy="zero2", world_size=1, rank=0, seq_len=128, tier="S",
+        steps=10, per_device_batch=2, grad_accum=1,
+        step_times=[0.1] * 8, losses=[5.0] * 8,
+    )
+    kwargs.update(over)
+    return metrics_mod.compute_result(**kwargs)
+
+
+def test_compute_result_maps_anatomy_fields():
+    r = _result(step_anatomy=dict(FROZEN_FIELDS))
+    assert r.comms_exposed_frac == 0.197
+    assert r.anatomy_compute_frac == 0.6897
+    assert r.comms_overlap_frac == 0.3333
+    assert r.anatomy_idle_frac == 0.1133
+    assert r.bubble_frac is None
+    assert r.roofline_flops_pct_of_peak == 25.0
+    assert r.straggler_skew_pct == 3.0
+    # And into the serialized row (the registry/parse_metrics surface).
+    assert r.to_dict()["comms_exposed_frac"] == 0.197
+
+
+def test_compute_result_defaults_to_none_without_trace():
+    r = _result()
+    assert r.comms_exposed_frac is None
+    assert r.bubble_frac is None
+
+
+def test_compute_result_refuses_unknown_anatomy_keys():
+    with pytest.raises(ValueError, match="unknown step_anatomy keys"):
+        _result(step_anatomy={"comms_exposed_frac": 0.1, "typo_key": 1.0})
+
+
+def test_emit_result_prints_anatomy_line(tmp_path, capsys):
+    r = _result(step_anatomy=dict(FROZEN_FIELDS))
+    metrics_mod.emit_result(r, str(tmp_path))
+    out = capsys.readouterr().out
+    assert "Step anatomy:     compute 69.0% / exposed comms 19.7% / " \
+           "idle 11.3%" in out
+    assert "(overlap 33.3% of collective time)" in out
+
+
+def test_validator_accepts_good_anatomy_and_flags_broken():
+    row = _result(step_anatomy=dict(FROZEN_FIELDS)).to_dict()
+    assert vr.validate_result(row, "r") == []
+    bad = dict(row, comms_exposed_frac=1.7)
+    assert any("outside [0, 1]" in v for v in vr.validate_result(bad, "r"))
+    bad = dict(row, anatomy_compute_frac=0.8, comms_exposed_frac=0.3,
+               anatomy_idle_frac=0.2)
+    assert any("components sum" in v for v in vr.validate_result(bad, "r"))
+    bad = dict(row, roofline_flops_pct_of_peak=140.0)
+    assert any("past peak" in v for v in vr.validate_result(bad, "r"))
+    bad = dict(row, straggler_skew_pct=-2.0)
+    assert any("negative" in v for v in vr.validate_result(bad, "r"))
+    # Rows without the fields (pre-anatomy artifacts) skip the envelope.
+    assert vr.validate_result(_result().to_dict(), "r") == []
+
+
+def test_make_report_step_anatomy_section():
+    import pandas as pd
+
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        make_report,
+    )
+
+    row = _result(step_anatomy=dict(FROZEN_FIELDS)).to_dict()
+    text = make_report.build_report(pd.DataFrame([row]))
+    assert "## Step anatomy (trace-derived)" in text
+    assert "| 69.0 | 19.7 | 33.3 | 11.3 |" in text
+    # No anatomy columns -> no section.
+    text = make_report.build_report(pd.DataFrame([_result().to_dict()]))
+    assert "## Step anatomy" not in text
+
+
+def test_platform_peak_tables():
+    assert platform_mod.device_peak_hbm_gbps("TPU v5 lite") == 819.0
+    assert platform_mod.device_peak_flops("TPU v5 lite") == 197.0e12
+    assert platform_mod.device_peak_hbm_gbps("cpu") is None
+    assert platform_mod.device_peak_flops("cpu") is None
+
+
+# ---------------------------------------------------------------------------
+# Secondary-metric gate (benchreg follow-up (a))
+# ---------------------------------------------------------------------------
+
+
+def _anatomy_row(tps, exposed, mfu=40.0, **over):
+    row = {
+        "strategy": "zero2", "world_size": 4, "rank": 0, "seq_len": 128,
+        "tier": "S", "steps": 50, "per_device_batch": 2, "grad_accum": 1,
+        "tokens_per_sec": tps, "mean_step_time_sec": 0.2, "mean_loss": 5.1,
+        "peak_vram_gb": 1.2, "h2d_gbps_per_gpu": 1e-4,
+        "attention_impl": "flash", "model_family": "tinygpt",
+        "mfu_pct": mfu, "peak_hbm_gb": 1.2,
+        "comms_exposed_frac": exposed,
+    }
+    row.update(over)
+    return row
+
+
+def _windows(dts):
+    return [{"step": 9 + 5 * i, "steps_in_window": 5, "dt": dt,
+             "loss": 5.5} for i, dt in enumerate(dts)]
+
+
+BASE_DTS = [0.2, 0.201, 0.199, 0.2, 0.202, 0.198, 0.2, 0.201, 0.199, 0.2]
+AA_DTS = [0.201, 0.199, 0.2, 0.2, 0.201, 0.2, 0.199, 0.202, 0.198, 0.2]
+
+
+def _seed_registry(tmp_path, exposed_values=(0.05, 0.052, 0.048, 0.051)):
+    """A registry with >= MIN_SCALAR_HISTORY same-config ok records, each
+    carrying the secondary metrics in its result row."""
+    reg = rstore.Registry(str(tmp_path / "reg"))
+    for i, exposed in enumerate(exposed_values):
+        rec = rstore.make_record(
+            arm="anatomy_arm",
+            result_row=_anatomy_row(5120.0 + i, exposed, mfu=40.0 + 0.1 * i),
+            windows=_windows(BASE_DTS), tokens_per_step=1024,
+            source=f"result_{i}.json",
+        )
+        reg.ingest(rec)
+    return reg
+
+
+def test_gate_names_injected_exposed_comms_regression(tmp_path, capsys):
+    """The acceptance proof: a candidate whose PRIMARY tokens/sec is A/A
+    but whose comms_exposed_frac quadrupled fails `regress gate --all`
+    exit 1 naming the secondary metric — an overlap regression fails CI
+    by name just like a tokens/sec one."""
+    reg = _seed_registry(tmp_path)
+    cand = rstore.make_record(
+        arm="anatomy_arm", result_row=_anatomy_row(5120.5, 0.20),
+        windows=_windows(AA_DTS), tokens_per_step=1024,
+        source="result_cand.json",
+    )
+    reg.ingest(cand)
+    rc = rcompare.main(["--registry", str(tmp_path / "reg"), "gate", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    line = next(l for l in out.splitlines() if "REGRESSION" in l)
+    assert "metric=comms_exposed_frac" in line
+    assert "arm=anatomy_arm" in line
+    # Direction sign: +14.9pp of exposed comms (0.051 baseline -> 0.20),
+    # on the absolute percentage-point scale — the gate line prints the
+    # pp unit so the triage read can't mistake it for a relative delta.
+    assert "delta=+14.90pp" in line and "threshold=2.00pp" in line
+    assert "absolute pp scale" in line
+    # Deterministic: the same records verdict identically on a rerun
+    # (banking shields future BASELINES, not the candidate itself — the
+    # same contract the primary-metric gate proof pins).
+    rc2 = rcompare.main(
+        ["--registry", str(tmp_path / "reg"), "gate", "--all"]
+    )
+    out2 = capsys.readouterr().out
+    assert rc2 == 1
+    assert next(l for l in out2.splitlines() if "REGRESSION" in l) == line
+
+
+def test_gate_aa_secondaries_stay_quiet(tmp_path, capsys):
+    """An A/A candidate (jittered primary + secondary) gates clean: the
+    per-metric noise floors keep weather out of the verdict."""
+    reg = _seed_registry(tmp_path)
+    cand = rstore.make_record(
+        arm="anatomy_arm", result_row=_anatomy_row(5121.0, 0.051),
+        windows=_windows(AA_DTS), tokens_per_step=1024,
+        source="result_cand.json",
+    )
+    reg.ingest(cand)
+    rc = rcompare.main(["--registry", str(tmp_path / "reg"), "gate", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 regression(s)" in out
+
+
+def test_gate_names_mfu_regression(tmp_path, capsys):
+    """MFU is a gated secondary too (direction sign: lower is worse)."""
+    reg = _seed_registry(tmp_path)
+    cand = rstore.make_record(
+        arm="anatomy_arm", result_row=_anatomy_row(5120.5, 0.05, mfu=30.0),
+        windows=_windows(AA_DTS), tokens_per_step=1024,
+        source="result_cand.json",
+    )
+    reg.ingest(cand)
+    rc = rcompare.main(["--registry", str(tmp_path / "reg"), "gate", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    line = next(l for l in out.splitlines() if "REGRESSION" in l)
+    assert "metric=mfu_pct" in line
+
+
+def test_secondary_needs_learned_noise_floor(tmp_path, capsys):
+    """Two history runs < MIN_SCALAR_HISTORY: the exposed-comms jump is
+    reported but cannot verdict — an unlearned floor must not mint a
+    regression (the same guard the primary scalar mode has)."""
+    reg = _seed_registry(tmp_path, exposed_values=(0.05,))
+    cand = rstore.make_record(
+        arm="anatomy_arm", result_row=_anatomy_row(5120.5, 0.20),
+        windows=_windows(AA_DTS), tokens_per_step=1024,
+        source="result_cand.json",
+    )
+    reg.ingest(cand)
+    rc = rcompare.main(["--registry", str(tmp_path / "reg"), "gate", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+def test_secondary_absent_fields_skip():
+    """Old records without anatomy/MFU fields compare exactly as before —
+    no secondary comparisons appear."""
+    base = rstore.make_record(
+        arm="a", result_row={"tokens_per_sec": 100.0, "strategy": "zero2"},
+        source="x.json",
+    )
+    cand = rstore.make_record(
+        arm="a", result_row={"tokens_per_sec": 101.0, "strategy": "zero2"},
+        source="y.json",
+    )
+    comps = rstats.compare_records(base, cand)
+    assert [c.metric for c in comps] == ["tokens_per_sec"]
+
+
+def test_profiled_runs_split_config_lineage(tmp_path):
+    """Profiling is methodology: a PROFILE=1 candidate (anatomy fields
+    non-null → trace-collection overhead inside the timed window) must
+    not gate against an unprofiled lineage or feed its noise floor — the
+    profiled marker joins the config key, so the first profiled run is a
+    first-run SKIP, not a false regression."""
+    reg = rstore.Registry(str(tmp_path / "reg"))
+    for i in range(4):
+        row = _anatomy_row(5120.0 + i, 0.05)
+        del row["comms_exposed_frac"]  # unprofiled lineage
+        reg.ingest(rstore.make_record(
+            arm="anatomy_arm", result_row=row, windows=_windows(BASE_DTS),
+            tokens_per_step=1024, source=f"result_{i}.json",
+        ))
+    cand = rstore.make_record(
+        arm="anatomy_arm", result_row=_anatomy_row(5121.0, 0.05),
+        windows=_windows(AA_DTS), tokens_per_step=1024,
+        source="result_cand.json",
+    )
+    reg.ingest(cand)
+    assert reg.baseline(
+        "anatomy_arm", exclude_record_id=cand["record_id"],
+        match_config_of=cand,
+    ) is None
+    # …and the unprofiled history stays invisible to the profiled
+    # candidate's primary noise floor too (shared _eligible chain).
+    assert reg.history_values(
+        "anatomy_arm", metric_name="tokens_per_sec",
+        exclude_record_id=cand["record_id"], match_config_of=cand,
+    ) == []
+
+
+def test_result_history_values_filters(tmp_path):
+    reg = _seed_registry(tmp_path)
+    vals = reg.result_history_values(
+        "anatomy_arm", result_key="comms_exposed_frac",
+    )
+    assert vals == [0.05, 0.052, 0.048, 0.051]
+    # Resumed rows never enter the noise floor.
+    reg.ingest(rstore.make_record(
+        arm="anatomy_arm",
+        result_row=_anatomy_row(5125.0, 0.30, resumed=True, n_restarts=1),
+        windows=_windows(AA_DTS), tokens_per_step=1024,
+        source="result_resumed.json",
+    ))
+    assert reg.result_history_values(
+        "anatomy_arm", result_key="comms_exposed_frac",
+    ) == vals
+
+
+# ---------------------------------------------------------------------------
+# Window-level anomaly masking (benchreg follow-up (c))
+# ---------------------------------------------------------------------------
+
+
+def _spike_events(include_resolve=True):
+    """A timed run whose windows 30/35 ran under an open spike."""
+    ev = [
+        {"event": "run_meta", "ts": 0.0, "rel": 0.0, "arm": "m",
+         "schema_version": 1, "tokens_per_step": 1024},
+        {"event": "phase_begin", "ts": 1.0, "rel": 1.0, "phase": "timed"},
+    ]
+    for i, (step, dt) in enumerate([
+        (10, 0.2), (15, 0.2), (20, 0.2), (25, 0.2),
+        (30, 0.7), (35, 0.7), (40, 0.2), (45, 0.2), (50, 0.2),
+    ]):
+        ev.append({"event": "step_window", "ts": 2.0 + i, "rel": 2.0 + i,
+                   "step": step, "steps_in_window": 5, "loss": 5.0,
+                   "window_mean_step_time_sec": dt, "cum_tokens": 1,
+                   "tokens_per_sec": 1.0, "phase": "timed"})
+        if step == 30:
+            ev.append({"event": "anomaly", "kind": "step_time_spike",
+                       "ts": 2.0 + i, "rel": 2.0 + i, "step": 30,
+                       "detail": "window mean 0.7s > 3x median"})
+        if step == 40 and include_resolve:
+            ev.append({"event": "anomaly_resolved",
+                       "kind": "step_time_spike", "ts": 2.0 + i,
+                       "rel": 2.0 + i, "step": 40, "opened_at_step": 30})
+    ev.append({"event": "phase_end", "ts": 20.0, "rel": 20.0,
+               "phase": "timed", "dur_sec": 19.0})
+    ev.append({"event": "run_end", "ts": 21.0, "rel": 21.0, "status": "ok",
+               "last_step": 50})
+    return ev
+
+
+def test_spike_mask_intervals_and_membership():
+    assert spike_mask_intervals(_spike_events()) == [(30, 40)]
+    assert spike_mask_intervals(_spike_events(False)) == [(30, None)]
+    iv = [(30, 40)]
+    assert step_in_spike(30, iv) and step_in_spike(35, iv)
+    assert not step_in_spike(40, iv)  # the resolving window is healthy
+    assert not step_in_spike(25, iv)
+    assert step_in_spike(99, [(30, None)])  # unresolved masks to the end
+
+
+def test_spike_mask_rebaseline_covers_resolving_window():
+    """A rebaseline resolution fires while the window is STILL elevated,
+    so — unlike a measured-back-under resolve — the resolving window
+    itself must stay masked."""
+    ev = _spike_events()
+    for e in ev:
+        if e.get("event") == "anomaly_resolved":
+            e["rebaselined"] = True
+            e["detail"] = "rebaselined after 5 windows at the new level"
+    assert spike_mask_intervals(ev) == [(30, 41)]
+    assert step_in_spike(40, spike_mask_intervals(ev))
+    kept, masked = rstats.split_masked_windows(ev)
+    assert [w["step"] for w in masked] == [30, 35, 40]
+    assert [w["step"] for w in kept] == [10, 15, 20, 25, 45, 50]
+
+
+def test_split_masked_windows_counts():
+    kept, masked = rstats.split_masked_windows(_spike_events())
+    assert [w["step"] for w in masked] == [30, 35]
+    assert [w["step"] for w in kept] == [10, 15, 20, 25, 40, 45, 50]
+    # timed_windows with masking drops them; without, keeps all 9.
+    assert len(rstats.timed_windows(_spike_events(), mask_spikes=True)) == 7
+    assert len(rstats.timed_windows(_spike_events())) == 9
+
+
+def test_ingest_masks_spike_windows_with_count(tmp_path):
+    """The stored record's comparison sample excludes the spike windows
+    and carries masked_windows — masking is never silent."""
+    row = _anatomy_row(5120.0, 0.05)
+    (tmp_path / "result_m.json").write_text(json.dumps(row))
+    with open(tmp_path / "telemetry_m.jsonl", "w") as f:
+        for e in _spike_events():
+            f.write(json.dumps(e) + "\n")
+    reg = rstore.Registry(str(tmp_path / "reg"))
+    rstore.ingest_results_dir(reg, str(tmp_path))
+    rec = reg.latest("m")
+    assert rec["masked_windows"] == 2
+    assert [w["step"] for w in rec["windows"]] == [10, 15, 20, 25, 40, 45, 50]
+    # And the verdict line carries the count via the comparison note.
+    base = rstore.make_record(
+        arm="m", result_row=row, windows=_windows(BASE_DTS),
+        tokens_per_step=1024, source="base.json",
+    )
+    comps = rstats.compare_records(base, rec)
+    assert "masked_windows=0/2" in comps[0].summary()
+
+
+def test_compare_telemetry_masks_and_reports(tmp_path):
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    for path, events in ((a, _spike_events(False)), (b, _spike_events())):
+        with open(path, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+    rep = rstats.compare_telemetry(
+        [json.loads(l) for l in a.read_text().splitlines()],
+        [json.loads(l) for l in b.read_text().splitlines()],
+    )
+    # a: spike never resolves -> windows 30..end masked (5); b: 2 masked.
+    assert rep["a"]["masked_windows"] == 5
+    assert rep["b"]["masked_windows"] == 2
+    assert "masked_windows=5/2" in rep["comparisons"][0].summary()
+    text = tr.format_compare(rep)
+    assert "masked_windows=5" in text
+
+
+# ---------------------------------------------------------------------------
+# Anomaly <-> trace join (telemetry follow-up (b))
+# ---------------------------------------------------------------------------
+
+
+def _spiky_trace(tmp_path):
+    """Steps 25..35; step 30's all-reduce grew 5x vs the median step."""
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 1, "tid": 10, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 1, "tid": 11, "name": "thread_name",
+         "args": {"name": "Steps"}},
+    ]
+    for i, step in enumerate((25, 30, 35)):
+        t0 = i * 100_000
+        ar = 50_000 if step == 30 else 10_000
+        events += [
+            {"ph": "X", "pid": 1, "tid": 11, "name": str(step), "ts": t0,
+             "dur": 90_000},
+            {"ph": "X", "pid": 1, "tid": 10, "name": "fusion.1", "ts": t0,
+             "dur": 30_000},
+            {"ph": "X", "pid": 1, "tid": 10, "name": "all-reduce.2",
+             "ts": t0 + 30_000, "dur": ar},
+        ]
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    with gzip.open(d / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(tmp_path)
+
+
+def test_anomaly_trace_join_names_grown_class(tmp_path):
+    prof = _spiky_trace(tmp_path)
+    tl = tr.build_timeline([
+        {"event": "run_meta", "ts": 0.0, "rel": 0.0, "arm": "x"},
+        {"event": "step_window", "ts": 5.0, "rel": 5.0, "step": 30,
+         "steps_in_window": 5, "loss": 5.0,
+         "window_mean_step_time_sec": 0.7, "phase": "timed"},
+        {"event": "anomaly", "kind": "step_time_spike", "ts": 5.0,
+         "rel": 5.0, "step": 30, "detail": "spike"},
+    ])
+    text = tr.join_anomaly_trace(tl, prof)
+    assert "spike at step 30" in text
+    assert "'all-reduce' grew 5.0x" in text
+    assert "10.00 ms -> 50.00 ms" in text
+
+
+def test_anomaly_trace_join_absent_without_spikes(tmp_path):
+    prof = _spiky_trace(tmp_path)
+    tl = tr.build_timeline([
+        {"event": "run_meta", "ts": 0.0, "rel": 0.0, "arm": "x"},
+    ])
+    assert tr.join_anomaly_trace(tl, prof) is None
+
+
+def test_anomaly_trace_join_uncovered_spike(tmp_path):
+    prof = _spiky_trace(tmp_path)
+    tl = tr.build_timeline([
+        {"event": "run_meta", "ts": 0.0, "rel": 0.0, "arm": "x"},
+        {"event": "anomaly", "kind": "step_time_spike", "ts": 5.0,
+         "rel": 5.0, "step": 999, "detail": "spike"},
+    ])
+    text = tr.join_anomaly_trace(tl, prof)
+    assert "outside the traced window" in text
+
+
+def test_report_cli_auto_joins_anomalies(tmp_path, capsys):
+    prof = _spiky_trace(tmp_path)
+    tpath = tmp_path / "telemetry_x.jsonl"
+    with open(tpath, "w") as f:
+        for e in [
+            {"event": "run_meta", "ts": 0.0, "rel": 0.0, "arm": "x",
+             "schema_version": 1},
+            {"event": "phase_begin", "ts": 1.0, "rel": 1.0,
+             "phase": "timed"},
+            {"event": "step_window", "ts": 5.0, "rel": 5.0, "step": 30,
+             "steps_in_window": 5, "loss": 5.0,
+             "window_mean_step_time_sec": 0.7, "cum_tokens": 1,
+             "tokens_per_sec": 1.0, "phase": "timed"},
+            {"event": "anomaly", "kind": "step_time_spike", "ts": 5.0,
+             "rel": 5.0, "step": 30, "detail": "spike"},
+        ]:
+            f.write(json.dumps(e) + "\n")
+    rc = tr.main(["--telemetry", str(tpath), "--profile-dir", prof])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Anomaly <-> trace join" in out
+    assert "'all-reduce' grew" in out
+
+
+# ---------------------------------------------------------------------------
+# Suite / tooling wiring pins
+# ---------------------------------------------------------------------------
+
+
+def test_suite_wires_profile_and_anatomy():
+    text = open(os.path.join(REPO, "scripts", "run_all_benchmarks.sh")).read()
+    assert 'PROFILE="${PROFILE:-0}"' in text
+    assert "--profile-dir $RESULTS_DIR/${name}_profile" in text
+    assert "analysis.step_anatomy" in text
+    assert "step_anatomy.txt" in text
+    assert "--step-anatomy" in text
+
+
+def test_bench_wires_profile_dir():
+    text = open(os.path.join(REPO, "bench.py")).read()
+    assert "--profile-dir" in text
+    assert "comms_exposed_frac" in text
+
+
+def test_cost_json_round_trip(tmp_path):
+    cost = {"flops": 1e9, "bytes_accessed": 1e6,
+            "device_kind": "TPU v5 lite", "world_size": 2,
+            "scope": "global_module"}
+    path = sa.write_cost_json(str(tmp_path), cost)
+    assert path and os.path.basename(path) == sa.COST_JSON_FILENAME
+    assert sa.load_cost_json(path) == cost
+
+
+# ---------------------------------------------------------------------------
+# Slow: the loop integration end-to-end on the CPU dryrun
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_harness_profile_dir_runs_anatomy(tmp_path):
+    """--profile-dir on a real (CPU) harness run stays green and either
+    publishes the anatomy fields or degrades with the explicit skip
+    warning (the CPU backend's trace may carry no device step lane —
+    the documented dryrun caveat)."""
+    results = tmp_path / "results"
+    prof = tmp_path / "prof"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [
+            sys.executable, "-u",
+            os.path.join(REPO, "benchmarking", "train_harness.py"),
+            "--strategy", "zero2", "--world-size", "4", "--rank", "0",
+            "--tier", "S", "--seq-len", "64", "--steps", "8",
+            "--warmup-steps", "2", "--per-device-batch", "2",
+            "--grad-accum", "2", "--results-dir", str(results),
+            "--profile-dir", str(prof),
+        ],
+        capture_output=True, text=True, env=env, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    row = json.loads(
+        (results / "result_zero2_ws4_seq64_tierS.json").read_text()
+    )
+    if row.get("comms_exposed_frac") is None:
+        assert "step-anatomy attribution skipped" in proc.stdout \
+            or "== Step anatomy" not in proc.stdout
+    else:
+        assert 0.0 <= row["comms_exposed_frac"] <= 1.0
+        assert "== Step anatomy" in proc.stdout
