@@ -78,10 +78,15 @@ FLAGSHIP_PER_DEVICE_BATCH = 2
 FLAGSHIP_GRAD_ACCUM = 2
 FLAGSHIP_LAYER_LOOP = "unrolled"
 
+# The remat/HBM frontier (--remat-sweep): every policy the model accepts
+# (models/tinygpt.normalize_remat) plus 'auto' (the loop's AOT-probe
+# resolver). Ordered from zero recompute to full recompute.
+REMAT_SWEEP_POLICIES = ("none", "dots", "full", "auto")
+
 
 def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
                  layer_loop, attention_impl=None, dropout="inherit",
-                 use_checkpoint=True, profile_dir=None):
+                 use_checkpoint=True, profile_dir=None, remat="inherit"):
     """Run one benchmark arm and return its contract-shaped row dict.
 
     Shared by the parity row and the flagship sub-object so the contract
@@ -93,6 +98,16 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
     from distributed_llm_training_benchmark_framework_tpu.parallel import get_strategy
     from distributed_llm_training_benchmark_framework_tpu.train.loop import run_benchmark
 
+    strategy = get_strategy(args.strategy)
+    if remat != "inherit":
+        # Remat/HBM frontier sweep (--remat-sweep): the same arm at an
+        # overridden remat policy. Strategy-level because that is where
+        # the policy lives for every arm (train/step.py folds it into the
+        # model config; 'auto' resolves via the loop's AOT probe).
+        import dataclasses
+
+        strategy = dataclasses.replace(strategy, remat=remat)
+
     # Keep stdout clean for the single JSON line; progress goes to stderr.
     # Checkpointing (off by default — a headline measurement doesn't
     # checkpoint): --checkpoint-dir/-every/-async thread through so the
@@ -100,7 +115,7 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
     # (time_in_checkpoint_sec rides the contract row's phase fields).
     with contextlib.redirect_stdout(sys.stderr):
         result = run_benchmark(
-            strategy=get_strategy(args.strategy),
+            strategy=strategy,
             tier=args.tier,
             seq_len=args.seq_len,
             model_family=model_family,
@@ -123,6 +138,13 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
         )
     per_chip = result.tokens_per_sec / world
     row_extra = {}
+    if result.xla_scheduler_flags:
+        # Scheduler-flag provenance (additive, only when flags are live):
+        # store.config_key reads it off the row, so a --xla-latency-hiding
+        # run forms its own regress lineage instead of cross-gating
+        # against unflagged history. Default runs keep the contract row
+        # byte-identical (empty fingerprint -> key omitted -> "" lineage).
+        row_extra["xla_scheduler_flags"] = result.xla_scheduler_flags
     if result.comms_exposed_frac is not None:
         # Step-anatomy secondaries (additive, only when the arm profiled):
         # these ride into the registry record's result row, where the gate
@@ -135,6 +157,25 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
                 "roofline_flops_pct_of_peak", "roofline_hbm_pct_of_peak",
             ) if getattr(result, k) is not None
         }
+    if remat != "inherit":
+        # Frontier-sweep provenance: the REQUESTED policy keys the regress
+        # lineage (store.config_key) — 'auto' stays one lineage even
+        # though the probe may resolve it differently across hardware —
+        # and the resolved policy + HBM headroom (capacity minus measured
+        # peak; None off-TPU) make the frontier table self-contained.
+        from distributed_llm_training_benchmark_framework_tpu.utils import (
+            memory as memory_mod,
+        )
+
+        cap = memory_mod.device_hbm_bytes(result.device_kind)
+        row_extra.update({
+            "remat_policy": remat,
+            "remat_policy_resolved": result.remat_policy,
+            "hbm_headroom_gb": (
+                round(cap / 2**30 - result.peak_hbm_gb, 2)
+                if cap else None
+            ),
+        })
     return {
         "metric": (
             f"{model_family}_tier{args.tier}_seq{args.seq_len}"
@@ -234,6 +275,22 @@ def build_parser():
     p.add_argument("--registry", default=None,
                    help="registry root (default: $REGRESS_REGISTRY or "
                         "results/registry)")
+    # Overlap round 2 (docs/PERFORMANCE.md): the latency-hiding-scheduler
+    # XLA flag set (utils.platform.LATENCY_HIDING_XLA_FLAGS), applied
+    # before backend init. Recorded as xla_scheduler_flags in every row,
+    # which keys a SEPARATE regress lineage — flagged and unflagged runs
+    # never cross-gate.
+    p.add_argument("--xla-latency-hiding", action="store_true",
+                   help="turn on XLA's latency-hiding scheduler + async "
+                        "collective fusion for this invocation")
+    # Remat/HBM frontier sweep: re-run the flagship arm once per remat
+    # policy and report tokens/sec vs peak-HBM per policy (additive
+    # "remat_sweep" sub-object; one registry record per policy, the
+    # policy inside the config key so lineages stay separate).
+    p.add_argument("--remat-sweep", action="store_true",
+                   help="sweep the flagship arm across remat policies "
+                        f"{REMAT_SWEEP_POLICIES} (the HBM-vs-recompute "
+                        "frontier; make_report renders the table)")
     return p
 
 
@@ -244,10 +301,14 @@ def main():
         run_preflight()
 
     from distributed_llm_training_benchmark_framework_tpu.utils.platform import (
+        apply_latency_hiding_flags,
         honor_jax_platforms_env,
     )
 
     honor_jax_platforms_env()
+    if args.xla_latency_hiding:
+        # Must precede the first jax backend touch below.
+        apply_latency_hiding_flags()
 
     import jax
 
@@ -300,6 +361,27 @@ def main():
             "layer_loop": FLAGSHIP_LAYER_LOOP,
         }
 
+    if args.remat_sweep:
+        # The HBM-vs-recompute frontier: the flagship configuration once
+        # per policy (additive "remat_sweep" sub-object keyed by the
+        # REQUESTED policy — rows carry the resolved policy and the
+        # per-chip HBM headroom; make_report renders the frontier table
+        # from the registry records these become).
+        payload["remat_sweep"] = {
+            pol: _measure_row(
+                args, world,
+                model_family=FLAGSHIP_FAMILY,
+                per_device_batch=FLAGSHIP_PER_DEVICE_BATCH,
+                grad_accum=FLAGSHIP_GRAD_ACCUM,
+                layer_loop=FLAGSHIP_LAYER_LOOP,
+                attention_impl="flash",
+                dropout=None,
+                use_checkpoint=False,
+                remat=pol,
+            )
+            for pol in REMAT_SWEEP_POLICIES
+        }
+
     print(json.dumps(payload))
     record_in_registry(args, payload)
 
@@ -321,7 +403,7 @@ def registry_rows(args, payload):
         "sync_every": args.sync_every,
     }
     rows = [("bench.py", {k: v for k, v in payload.items()
-                          if k != "flagship"},
+                          if k not in ("flagship", "remat_sweep")},
              dict(run_params, model_family=args.model_family,
                   per_device_batch=args.per_device_batch,
                   grad_accum=args.grad_accum,
@@ -330,6 +412,18 @@ def registry_rows(args, payload):
         # The flagship sub-object already carries its swept geometry
         # provenance keys; only the shared run length is added.
         rows.append(("bench.py:flagship", payload["flagship"], run_params))
+    for pol, row in sorted(payload.get("remat_sweep", {}).items()):
+        # One record per policy. The row already carries remat_policy
+        # (the config-key axis that keeps each policy its own lineage);
+        # the flagship geometry is backfilled the same way the flagship
+        # sub-object records its own.
+        rows.append((
+            f"bench.py:remat-sweep:{pol}", row,
+            dict(run_params, model_family=FLAGSHIP_FAMILY,
+                 per_device_batch=FLAGSHIP_PER_DEVICE_BATCH,
+                 grad_accum=FLAGSHIP_GRAD_ACCUM,
+                 layer_loop=FLAGSHIP_LAYER_LOOP),
+        ))
     return rows
 
 

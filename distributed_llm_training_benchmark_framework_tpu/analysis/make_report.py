@@ -147,6 +147,97 @@ def trend_section(registry_root: str, limit: int = 5) -> List[str]:
         return ["## Per-arm trend (registry)", "", f"_unavailable: {e}_", ""]
 
 
+#: Frontier row order: zero recompute -> full recompute, the probe last.
+_REMAT_ORDER = {"none": 0, "dots": 1, "full": 2, "auto": 3}
+
+
+def remat_frontier_section(registry_root: str) -> List[str]:
+    """The HBM-vs-recompute frontier from ``bench.py --remat-sweep`` records.
+
+    One table per swept arm: the newest record per remat policy —
+    tokens/sec/chip vs measured peak HBM (with the per-chip headroom the
+    memory estimator prints), delta vs the no-remat point. Records are
+    identified by a non-null ``remat_policy`` in their result row (the
+    sweep stamps it; ordinary bench/flagship rows never carry it).
+
+    The table only mixes records from ONE config lineage (the newest
+    sweep record's ``store.config_key`` with the policy axis
+    neutralized): a later ``--steps 12`` smoke sweep must not lend its
+    'none' base to an older full-length sweep's rows — the exact
+    cross-lineage comparison the config key exists to prevent. Omitted
+    older-lineage sweep records are counted in a note, never silent.
+    """
+    from ..regress import store as regress_store
+
+    def lineage(rec):
+        # The config key with remat_policy neutralized: rows of one
+        # sweep share it, sweeps at different run shapes do not.
+        r = dict(rec.get("result") or {})
+        r.pop("remat_policy", None)
+        return regress_store.config_key({**rec, "result": r})
+
+    try:
+        reg = regress_store.Registry(registry_root)
+        if not reg.exists():
+            return []
+        by_arm: dict = {}
+        omitted = 0
+        for arm in reg.arms():
+            sweep = [rec for rec in reg.records(arm)  # oldest -> newest
+                     if (rec.get("result") or {}).get("remat_policy")]
+            if not sweep:
+                continue
+            lin = lineage(sweep[-1])
+            for rec in sweep:
+                if lineage(rec) == lin:  # newest wins within the lineage
+                    by_arm.setdefault(arm, {})[
+                        rec["result"]["remat_policy"]] = rec
+                else:
+                    omitted += 1
+        if not by_arm:
+            return []
+        out = ["## Remat/HBM frontier (`bench.py --remat-sweep`)", "",
+               "Tokens/sec vs peak HBM per rematerialization policy — the "
+               "recompute-for-memory trade (docs/PERFORMANCE.md). Each "
+               "policy is its own regress lineage (the policy is part of "
+               "the registry config key); *headroom* is per-chip HBM "
+               "capacity minus the measured peak (blank off-TPU).", ""]
+        if omitted:
+            out.append(f"_{omitted} older-lineage sweep record(s) "
+                       "(different run shape) omitted from the tables._")
+            out.append("")
+        for arm in sorted(by_arm):
+            pols = by_arm[arm]
+            out.append(f"### {arm}")
+            out.append("")
+            out.append("| policy | resolved | tokens/sec/chip | vs none "
+                       "| peak HBM GB | headroom GB | MFU % |")
+            out.append("|---|---|---|---|---|---|---|")
+            base = ((pols.get("none") or {}).get("metric") or {}).get("value")
+            for pol in sorted(pols, key=lambda p: _REMAT_ORDER.get(p, 9)):
+                rec = pols[pol]
+                row = rec.get("result") or {}
+                val = (rec.get("metric") or {}).get("value")
+                delta = (f"{100.0 * (val - base) / base:+.1f}%"
+                         if val is not None and base else "-")
+
+                def num(key, fmt="{:,.2f}"):
+                    v = row.get(key)
+                    return fmt.format(v) if isinstance(v, (int, float)) else "-"
+
+                out.append(
+                    f"| {pol} | {row.get('remat_policy_resolved') or '-'} "
+                    f"| {f'{val:,.2f}' if val is not None else '-'} "
+                    f"| {delta} | {num('peak_hbm_gb')} "
+                    f"| {num('hbm_headroom_gb')} | {num('mfu_pct')} |"
+                )
+            out.append("")
+        return out
+    except regress_store.SchemaDrift as e:
+        return ["## Remat/HBM frontier (`bench.py --remat-sweep`)", "",
+                f"_unavailable: {e}_", ""]
+
+
 def anatomy_section(df: pd.DataFrame) -> List[str]:
     """Step-anatomy table for every row that carries the trace-derived
     attribution (arms run with --profile-dir; analysis/step_anatomy.py).
@@ -344,6 +435,7 @@ def build_report(
                     ""]
 
     if registry_root:
+        out += remat_frontier_section(registry_root)
         out += trend_section(registry_root)
 
     out += ["## Plots", ""]

@@ -76,10 +76,13 @@ def main(argv=None) -> int:
                         "instead of diffing against it")
     p.add_argument("--json", action="store_true",
                    help="emit the audit reports as JSON on stdout")
-    p.add_argument("--inject", default=None, choices=["bad-kv-spec"],
+    p.add_argument("--inject", default=None,
+                   choices=["bad-kv-spec", "bad-fsdp-axis"],
                    help="self-test: deliberately reintroduce a known-bad "
-                        "sharding (the PR 1 GQA kv full-replicate fallback) "
-                        "— the audit MUST then fail")
+                        "sharding (bad-kv-spec = the PR 1 GQA kv "
+                        "full-replicate fallback; bad-fsdp-axis = the "
+                        "pre-round-8 composed dp x tp fsdp placement) — "
+                        "the audit MUST then fail")
     args = p.parse_args(argv)
 
     if args.inject and args.update_budgets:

@@ -40,7 +40,7 @@ COLLECTIVE_OPS = (
     "all-to-all",
 )
 
-_INJECTIONS = ("bad-kv-spec",)
+_INJECTIONS = ("bad-kv-spec", "bad-fsdp-axis")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,14 +118,30 @@ ROSTER: Dict[str, ArmSpec] = {
             "llama-tp2-gqa", "ddp", (1, 1, 2), ("data", "seq", "model"),
             global_batch=2, model_family="llama",
         ),
-        # llama x fsdp x tp — the suite's llama-tp2 composition arm shape.
-        # NOTE: the frozen budget for this arm banks 13 reshard suspects —
-        # the fsdp('data')-sharded param layout composed with tp('model')
-        # resharding is a REAL pre-existing fallback in this composition,
-        # pinned here so it cannot GROW and so a future layout fix shows up
-        # as a bankable improvement (ROADMAP open item).
+        # llama x fsdp x tp — the suite's llama-tp2 composition arm shape,
+        # compiled with the UNROLLED layer loop because that is what the
+        # suite actually runs (scripts/run_all_benchmarks.sh LAYER_LOOP
+        # defaults to 'unrolled'; through PR 7 this arm audited the scan
+        # lowering the suite never measures). Round 8 fixed the composed
+        # dp x tp fsdp-axis placement (strategies._shard_largest_free_axis
+        # tile-order hygiene): the 13 banked replication-reshard suspects
+        # (collective-permutes against transposed device orders) are now 0.
+        # `--inject bad-fsdp-axis` proves the auditor still catches the old
+        # placement.
         ArmSpec(
             "llama-fsdp-dp4-tp2", "fsdp", (4, 1, 2), ("data", "seq", "model"),
+            global_batch=8, model_family="llama",
+            config_overrides=(("scan_layers", False),),
+        ),
+        # The same composition under the scan layer loop (the harness
+        # default; pipeline-sharded runs and compile-time-sensitive runs
+        # still use it). The round-8 spec rules cut its fallback 13 -> 4;
+        # the residue is the scan-carry layout XLA picks for the stacked
+        # activation stash — banked here so it cannot grow, and so a future
+        # scan-carry fix shows up as a bankable improvement.
+        ArmSpec(
+            "llama-fsdp-dp4-tp2-scan", "fsdp", (4, 1, 2),
+            ("data", "seq", "model"),
             global_batch=8, model_family="llama",
         ),
         # Sequence parallel: the ring's collective-permute hops are the
@@ -217,6 +233,8 @@ def lower_arm(spec: ArmSpec, devices=None):
 
     if spec.inject == "bad-kv-spec":
         return _with_bad_kv_spec(compile_)
+    if spec.inject == "bad-fsdp-axis":
+        return _with_bad_fsdp_axis(compile_)
     return compile_()
 
 
@@ -243,6 +261,26 @@ def _with_bad_kv_spec(fn):
         return fn()
     finally:
         strat.param_partition_specs = real
+
+
+def _with_bad_fsdp_axis(fn):
+    """Run ``fn`` with the composed dp x tp fsdp-axis hygiene disabled.
+
+    Reverts ``strategies._shard_largest_free_axis`` to the pre-round-8
+    unrestricted largest-free-axis placement: fsdp 'data' lands AFTER the
+    leaf's 'model' axis on row-parallel/vocab leaves (wo/wproj/wte/
+    lm_head), producing the transposed device-order tilings whose reshard
+    chains lowered as 13 collective-permutes per step on the
+    llama-fsdp-dp4-tp2 arm. The audit must flag the regression; the
+    injection exists so CI can prove it does.
+    """
+    from ...parallel import strategies as strat
+
+    strat._COMPOSED_FSDP_HYGIENE = False
+    try:
+        return fn()
+    finally:
+        strat._COMPOSED_FSDP_HYGIENE = True
 
 
 # One instruction definition per line: "%name = <shape> <opcode>(...". The

@@ -30,6 +30,12 @@ deployment invariant this codebase has already paid for once:
          (a handler interrupting arbitrary bytecode mid-commit is how
          torn state happens), and fsync/fdatasync block the host thread
          for device-unrelated milliseconds inside published step times.
+- GC107  dtype-less ``jnp.asarray``/``jnp.array``/constant constructors
+         (``jnp.ones``/``jnp.zeros``/``jnp.empty``/``jnp.full``) inside
+         jitted model code (``models/``, ``train/step.py``): the default
+         dtype is float32, and one f32 constant silently promotes the
+         surrounding bf16 arithmetic — exactly the bf16->f32 convert
+         chains the HLO auditor budgets (``bf16_to_f32_converts``).
 - GC201  entrypoint<->harness flag-surface drift (PR 1's detector, now a
          registry rule): every ``train/harness.py`` flag must be reachable
          from the container env in ``docker/entrypoint.sh`` and vice versa.
@@ -559,6 +565,61 @@ def _check_time_time(root: str) -> Iterator[Violation]:
                     "time.time() call in jit-adjacent code",
                     RULES["GC104"].fix_hint,
                 )
+
+
+# ---------------------------------------------------------------------------
+# GC107: implicit f32 constant promotion in jitted model code
+# ---------------------------------------------------------------------------
+
+#: Constructor -> index of the positional argument that IS the dtype (a
+#: call with that many positionals has pinned it positionally, like
+#: ``jnp.zeros(shape, c.param_dtype)``). ``asarray``/``array`` take dtype
+#: second; ``full`` takes (shape, fill_value, dtype).
+_GC107_CONSTRUCTORS = {
+    "jnp.asarray": 1, "jnp.array": 1,
+    "jnp.ones": 1, "jnp.zeros": 1, "jnp.empty": 1,
+    "jnp.full": 2,
+}
+
+
+@_rule(
+    "GC107",
+    "implicit-f32-constant-in-model-code",
+    "dtype-less jnp.asarray/jnp.array/ones/zeros/empty/full inside jitted "
+    "model code (models/, train/step.py) — the float32 default silently "
+    "promotes bf16 arithmetic around it, minting the bf16->f32 convert "
+    "chains the collective budgets pin",
+    "pass dtype= (the config's compute/param dtype, or the operand's "
+    "x.dtype) so the constant joins the surrounding precision; python "
+    "scalars in arithmetic stay weakly typed and need no wrapper — often "
+    "the fix is deleting the jnp.asarray() entirely; suppress deliberate "
+    "f32 islands (loss accumulators) with '# graftcheck: disable=GC107'",
+)
+def _check_implicit_f32_constants(root: str) -> Iterator[Violation]:
+    targets = list(_package_files(root, ("models",)))
+    step_py = os.path.join(root, PACKAGE, "train", "step.py")
+    if os.path.exists(step_py):
+        targets.append(_Tree(step_py, os.path.relpath(step_py, root)))
+    for tree in targets:
+        for node in ast.walk(tree.ast):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            dtype_pos = _GC107_CONSTRUCTORS.get(name or "")
+            if dtype_pos is None:
+                continue
+            if len(node.args) > dtype_pos:  # dtype pinned positionally
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            if _suppressed(tree, node.lineno, "GC107"):
+                continue
+            yield Violation(
+                "GC107", tree.rel, node.lineno,
+                f"{name}(...) without a dtype defaults to float32 inside "
+                "jitted model code",
+                RULES["GC107"].fix_hint,
+            )
 
 
 # ---------------------------------------------------------------------------

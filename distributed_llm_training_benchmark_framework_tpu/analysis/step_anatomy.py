@@ -210,13 +210,16 @@ def analyze_steps(
         comp_iv: List[Tuple[float, float]] = []
         coll_iv: List[Tuple[float, float]] = []
         coll_by_class: collections.Counter = collections.Counter()
+        class_iv: Dict[str, List[Tuple[float, float]]] = {}
         for op, a, b in ops:
             lo, hi = max(a, t0), min(b, t1)
             if hi <= lo:
                 continue
             if is_collective_op(op):
                 coll_iv.append((lo, hi))
-                coll_by_class[ps.op_class(op)] += hi - lo
+                cls = ps.op_class(op)
+                coll_by_class[cls] += hi - lo
+                class_iv.setdefault(cls, []).append((lo, hi))
             else:
                 comp_iv.append((lo, hi))
         comp_u = merge_intervals(comp_iv)
@@ -226,6 +229,20 @@ def analyze_steps(
         coll_total = intervals_length(coll_u)
         overlapped = intervals_length(intersect_intervals(coll_u, comp_u))
         exposed = coll_total - overlapped
+        # Per-class EXPOSED time: the class's own interval union minus the
+        # part hidden under compute — this is what names WHICH collective
+        # to overlap first (round-8 satellite). Classes are exposed
+        # independently, so two different-class collectives overlapping
+        # each other (and not compute) each count their shared time: the
+        # per-class sum may slightly exceed `exposed`, which is the
+        # union-accurate total.
+        exposed_by_class: collections.Counter = collections.Counter()
+        for cls, ivs in class_iv.items():
+            u = merge_intervals(ivs)
+            exp_c = (intervals_length(u)
+                     - intervals_length(intersect_intervals(u, comp_u)))
+            if exp_c > 0:
+                exposed_by_class[cls] = exp_c
         dur = t1 - t0
         idle = max(dur - intervals_length(busy), 0.0)
         out.append({
@@ -236,6 +253,7 @@ def analyze_steps(
             "overlapped_us": overlapped,
             "idle_us": idle,
             "coll_by_class": coll_by_class,
+            "exposed_by_class": exposed_by_class,
         })
     return out
 
@@ -479,8 +497,10 @@ def analyze_profile_dir(
     coll_total = totals["exposed_us"] + totals["overlapped_us"]
     dur = totals["dur_us"] or 1.0
     coll_classes: collections.Counter = collections.Counter()
+    exposed_classes: collections.Counter = collections.Counter()
     for s in all_steps:
         coll_classes.update(s["coll_by_class"])
+        exposed_classes.update(s.get("exposed_by_class", {}))
     n_steps = len(all_steps)
     median_step_us = _median([s["dur_us"] for s in all_steps])
 
@@ -519,6 +539,13 @@ def analyze_profile_dir(
         ),
         "straggler_skew_pct": skew_pct,
         "top_collectives": coll_classes.most_common(6),
+        # Exposed time split by collective class (all-gather /
+        # reduce-scatter / all-reduce / collective-permute / ...), most
+        # exposed first — the table that names which collective the next
+        # overlap PR should chase. Per-class values are independent
+        # unions minus compute cover, so their sum can slightly exceed
+        # exposed_us when different-class collectives co-expose.
+        "comms_exposed_by_class": exposed_classes.most_common(6),
         "pipeline_schedule": pipeline_schedule,
         # Device idle inside the step IS the pipeline bubble when the arm
         # runs a schedule; None for non-pipeline arms.
@@ -567,6 +594,25 @@ def analyze_profile_dir(
         "agg": agg,
         "roofline": roofline,
         "arm": meta.get("arm"),
+    }
+
+
+def exposed_by_class_fracs(report: Dict[str, Any]) -> Dict[str, float]:
+    """{collective class: exposed fraction OF THE STEP}, rounded.
+
+    The per-class payload the telemetry ``step_anatomy`` event carries
+    (train/loop.py) beside the scalar result fields — NOT a
+    BenchmarkResult field (``compute_result`` pins that schema), but the
+    flight-recorder record of which collective class the exposed time
+    belongs to.
+    """
+    agg = report["agg"]
+    dur = agg["mean_step_us"] * agg["n_steps"]
+    if dur <= 0:
+        return {}
+    return {
+        cls: round(us / dur, 4)
+        for cls, us in agg.get("comms_exposed_by_class", [])
     }
 
 
@@ -647,6 +693,17 @@ def format_report(report: Dict[str, Any]) -> str:
         )
         out.append("")
         out.append(f"  top collectives (per step): {tops}")
+    if agg.get("comms_exposed_by_class"):
+        # The overlap worklist: which collective class owns the exposed
+        # time (most exposed first — chase that one).
+        per_step = agg["n_steps"] or 1
+        exp_total = sum(us for _cls, us in agg["comms_exposed_by_class"])
+        byc = ", ".join(
+            f"{cls} {us / per_step / 1e3:.3f} ms"
+            + (f" ({100.0 * us / exp_total:.0f}%)" if exp_total > 0 else "")
+            for cls, us in agg["comms_exposed_by_class"]
+        )
+        out.append(f"  exposed by class (per step): {byc}")
     if agg["bubble_frac"] is not None:
         out.append(
             f"  bubble fraction ({agg['pipeline_schedule']}): "

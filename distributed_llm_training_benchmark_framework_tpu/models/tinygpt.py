@@ -164,6 +164,18 @@ class TinyGPTConfig:
     # Weight-tied LM head (reference train_harness.py:61-62). False adds a
     # separate 'lm_head' (V, D) leaf (Llama unties).
     tie_embeddings: bool = True
+    # ZeRO-2 per-block gradient placement (round 8): a sorted tuple of
+    # (block leaf name, PartitionSpec-for-one-layer-slice) pairs, set by
+    # the train step for sharded-grad/replicated-param strategies. When
+    # present, apply_blocks wraps each layer's weights in an identity
+    # whose COTANGENT carries the sharding constraint — so every layer's
+    # grad reduce-scatter issues INSIDE the backward layer loop, right
+    # after that layer's backward matmuls, instead of as one tail bundle
+    # after the whole backward. That is what lets XLA's latency-hiding
+    # scheduler overlap grad comms with the next layer's backward compute
+    # (DeepSpeed ZeRO's bucketed overlap, GSPMD-native). A tuple (not a
+    # dict) so the config stays hashable.
+    block_grad_spec: Any = None
 
     @property
     def head_dim(self) -> int:
@@ -514,6 +526,42 @@ def _attention(
     return out.astype(q.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _with_cotangent_spec(spec, x):
+    """Identity whose COTANGENT is constrained to ``spec``.
+
+    Wrapping a layer's weights with this inside the layer loop makes that
+    layer's gradient adopt its target (ZeRO-2 sharded) placement at the
+    point it is produced — inside the backward scan/loop body — so the
+    reduce-scatter can overlap the next layer's backward compute instead
+    of queueing in a tail bundle (see TinyGPTConfig.block_grad_spec).
+    """
+    return x
+
+
+def _wcs_fwd(spec, x):
+    return x, None
+
+
+def _wcs_bwd(spec, _res, g):
+    return (lax.with_sharding_constraint(g, spec),)
+
+
+_with_cotangent_spec.defvjp(_wcs_fwd, _wcs_bwd)
+
+
+def _constrain_layer_grads(config: TinyGPTConfig, layer: Params) -> Params:
+    """Apply ``config.block_grad_spec`` to one layer's weight slice (no-op
+    when unset). Leaves without a spec entry pass through untouched."""
+    if not config.block_grad_spec:
+        return layer
+    specs = dict(config.block_grad_spec)
+    return {
+        k: (_with_cotangent_spec(specs[k], v) if k in specs else v)
+        for k, v in layer.items()
+    }
+
+
 def _block(
     config: TinyGPTConfig,
     x: jax.Array,  # (B, S, D) compute dtype
@@ -706,14 +754,14 @@ def apply_blocks(
             ki = (
                 jax.random.fold_in(base_key, layer_offset + i) if live else None
             )
-            x, a = block(x, layer, ki)
+            x, a = block(x, _constrain_layer_grads(c, layer), ki)
             aux = aux + a
         return x, aux
 
     if base_key is None or deterministic:
         def scan_body(carry, layer):
             x, aux = carry
-            x, a = block(x, layer, None)
+            x, a = block(x, _constrain_layer_grads(c, layer), None)
             return (x, aux + a), None
 
         (x, aux), _ = lax.scan(scan_body, (x, _aux0()), blocks)
@@ -723,7 +771,10 @@ def apply_blocks(
 
         def scan_body(carry, li):
             x, aux = carry
-            x, a = block(x, li[0], jax.random.fold_in(base_key, li[1]))
+            x, a = block(
+                x, _constrain_layer_grads(c, li[0]),
+                jax.random.fold_in(base_key, li[1]),
+            )
             return (x, aux + a), None
 
         (x, aux), _ = lax.scan(

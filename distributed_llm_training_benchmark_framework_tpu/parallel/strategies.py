@@ -612,8 +612,25 @@ def _leaf_name(path) -> str:
     return "/".join(str(getattr(p, "key", p)) for p in path)
 
 
+#: Self-test escape hatch (graftcheck `--inject bad-fsdp-axis`): False
+#: reverts to the pre-round-8 unrestricted largest-free-axis placement,
+#: reintroducing the llama-fsdp-dp4-tp2 transposed-tiling reshard fallback
+#: so CI can prove the HLO auditor catches it.
+_COMPOSED_FSDP_HYGIENE = True
+
+#: Leaves smaller than this (total elements) are not worth FSDP-sharding in
+#: a composed dp x tp mesh: norm scales and biases are a few hundred
+#: elements per layer, and 'data'-sharding them buys ~nothing in HBM while
+#: costing an all-gather per use — measured 10 extra all-gathers per step
+#: on the llama-fsdp-dp4-tp2 arm (docs/PERFORMANCE.md round 8). Pure-dp
+#: meshes keep the old behavior (their frozen budgets pin it, and without
+#: a 'model' axis the gathers never risk the transposed-order permutes).
+_COMPOSED_MIN_SHARD_ELEMENTS = 4096
+
+
 def _shard_largest_free_axis(
-    spec: list, shape: Tuple[int, ...], n_shards: int, is_block_leaf: bool
+    spec: list, shape: Tuple[int, ...], n_shards: int, is_block_leaf: bool,
+    composed: bool = False,
 ) -> None:
     """FSDP-style: put 'data' on the largest unsharded divisible axis.
 
@@ -621,9 +638,38 @@ def _shard_largest_free_axis(
     axis over the layers axis: sharding inside the layer keeps the scan body's
     dynamic-slice local and lets XLA all-gather exactly one layer's shard per
     scan iteration (the FSDP/ZeRO-3 schedule). The layers axis is the fallback.
+
+    ``composed`` (a >1 'model' axis coexists with >1 'data') adds the
+    round-8 tile-order hygiene rules:
+
+    - 'data' only lands on an axis BEFORE the leaf's 'model' axis. The mesh
+      is data-major, so [.., 'data', .., 'model', ..] tiles enumerate
+      devices in iota order while the reverse order enumerates them
+      transposed — and GSPMD can only reshard between the two orders with
+      collective-permute chains. Row-parallel and vocab-sharded leaves
+      ('model' leads: wo/wproj/wte/lm_head) therefore keep model-only
+      sharding; column-parallel leaves (wq/wgu/wfc: 'model' trails) keep
+      their fsdp 'data' split. Measured on llama-fsdp-dp4-tp2 (unrolled):
+      13 replication-reshard suspects -> 0.
+    - vector-like leaves (< _COMPOSED_MIN_SHARD_ELEMENTS elements) stay
+      replicated over 'data' (see the constant's comment).
     """
+    if composed and _COMPOSED_FSDP_HYGIENE:
+        # Vector-likeness is a PER-LAYER property: block leaves are
+        # stacked (L, ...), and counting the layers axis would let a
+        # deep model's norm scales (L x D elements) dodge the rule the
+        # comment above sizes in per-layer units.
+        per_layer = shape[1:] if is_block_leaf and len(shape) > 1 else shape
+        size = 1
+        for d in per_layer:
+            size *= d
+        if "model" not in spec and size < _COMPOSED_MIN_SHARD_ELEMENTS:
+            return
     axes = list(range(len(shape)))
     candidates = axes[1:] + axes[:1] if is_block_leaf and len(shape) > 1 else axes
+    if composed and _COMPOSED_FSDP_HYGIENE and "model" in spec:
+        model_ax = spec.index("model")
+        candidates = [ax for ax in candidates if ax < model_ax]
     best = None
     for ax in candidates:
         if spec[ax] is None and shape[ax] % n_shards == 0 and shape[ax] >= n_shards:
@@ -656,6 +702,12 @@ def param_partition_specs(
     duplicates only the small kv projection einsum (2/(2+q_heads/kv_heads)
     of one attention projection) and emits zero resharding collectives —
     the Megatron choice for tp > kv_heads.
+
+    Composed dp x tp meshes additionally apply the round-8 tile-order
+    hygiene rules (see ``_shard_largest_free_axis``): 'data' never lands
+    after a leaf's 'model' axis (the transposed tile order is the
+    llama-fsdp-dp4-tp2 collective-permute fallback) and vector-like leaves
+    stay replicated over 'data'.
     """
     n_data = mesh.shape.get("data", 1)
     n_model = mesh.shape.get("model", 1)
@@ -694,7 +746,9 @@ def param_partition_specs(
                 if s[ax] is None and leaf.shape[ax] % n_model == 0:
                     s[ax] = "model"
         if shard and n_data > 1:
-            _shard_largest_free_axis(s, leaf.shape, n_data, is_block)
+            _shard_largest_free_axis(
+                s, leaf.shape, n_data, is_block, composed=n_model > 1
+            )
         return P(*s)
 
     return jax.tree_util.tree_map_with_path(spec, params)

@@ -170,6 +170,10 @@ def env_fingerprint(result_row: Optional[Dict[str, Any]] = None) -> Dict[str, An
     fp["device_kind"] = r.get("device_kind") or None
     fp["backend"] = r.get("backend") or None
     fp["attention_impl"] = r.get("attention_impl") or None
+    # Scheduling-relevant XLA flags (latency-hiding scheduler, async
+    # collectives — utils.platform.scheduler_flags_fingerprint): an env
+    # change that moves the collective schedule must be visible in triage.
+    fp["xla_scheduler_flags"] = r.get("xla_scheduler_flags") or ""
     fp["mesh"] = {
         "world_size": r.get("world_size"),
         "tensor_parallel": r.get("tensor_parallel", 1),
@@ -207,6 +211,14 @@ def config_key(record: Dict[str, Any]) -> Tuple:
         # gate against (or feed the noise floor of) an unprofiled lineage.
         # Anatomy fields are non-null exactly when the run profiled.
         r.get("comms_exposed_frac") is not None,
+        # The latency-hiding scheduler changes the collective schedule —
+        # a flagged run is a different measurement lineage than an
+        # unflagged one (legacy records carry no field -> "" -> they stay
+        # in the unflagged lineage, byte-compatible with their history).
+        r.get("xla_scheduler_flags") or "",
+        # Remat policy trades HBM for recompute: every --remat-sweep
+        # point is its own lineage (absent on legacy rows -> None).
+        r.get("remat_policy"),
     )
 
 

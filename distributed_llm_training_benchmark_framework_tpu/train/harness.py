@@ -219,6 +219,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "an exact sync-window boundary so chaos runs are "
                         "reproducible (scripts/chaos_suite.sh drives the "
                         "matrix)")
+    # Overlap round 2 (docs/PERFORMANCE.md): turn on XLA's latency-hiding
+    # scheduler + async collective fusion (utils.platform
+    # .LATENCY_HIDING_XLA_FLAGS) — the compiler half of the zero2
+    # per-block reduce-scatter overlap. The flag set joins the result
+    # row's env fingerprint (xla_scheduler_flags) and the regress
+    # registry's config key, so flagged and unflagged runs never
+    # cross-gate.
+    p.add_argument("--xla-latency-hiding", action="store_true",
+                   help="Append the latency-hiding-scheduler XLA flag set "
+                        "to XLA_FLAGS before backend init (recorded in "
+                        "the result row as xla_scheduler_flags)")
     return p
 
 
@@ -257,6 +268,12 @@ def main(argv=None) -> int:
 
     honor_jax_platforms_env()
     args = build_parser().parse_args(argv)
+    if args.xla_latency_hiding:
+        # Must land in XLA_FLAGS before the first backend client exists —
+        # setup_distributed below initializes it.
+        from ..utils.platform import apply_latency_hiding_flags
+
+        apply_latency_hiding_flags()
     if args.flash_pallas_backward and args.flash_blockwise_backward:
         raise SystemExit(
             "--flash-pallas-backward and --flash-blockwise-backward are "

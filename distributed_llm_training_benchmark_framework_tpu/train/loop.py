@@ -994,7 +994,16 @@ def _run_benchmark_impl(
                 pipeline_schedule=(pipeline_schedule if pp > 1 else None),
             )
             step_anatomy_fields = anatomy_mod.result_fields(report)
-            recorder.note("step_anatomy", **step_anatomy_fields)
+            # The per-class exposed split rides the telemetry event only
+            # (compute_result pins the scalar result schema): the flight
+            # recorder names WHICH collective class owns the exposed
+            # time, most exposed first.
+            recorder.note(
+                "step_anatomy", **step_anatomy_fields,
+                comms_exposed_by_class=(
+                    anatomy_mod.exposed_by_class_fracs(report)
+                ),
+            )
             print(anatomy_mod.format_report(report))
         except Exception as e:
             print(f"WARNING: step-anatomy attribution skipped: {e}")

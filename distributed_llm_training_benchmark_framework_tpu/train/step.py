@@ -98,6 +98,43 @@ def _resolve_model_config(
 
 
 
+def zero2_block_grad_spec(
+    strategy: strat.StrategyConfig,
+    grad_sharded_specs: Params,
+    pipelined: bool,
+):
+    """The per-layer-slice grad placement for the zero2 overlap path.
+
+    ZeRO-2 overlap (round 8): handing the model this spec table
+    (``TinyGPTConfig.block_grad_spec``) makes each block's gradient adopt
+    its reduce-scattered placement INSIDE the backward layer loop
+    (``tinygpt._with_cotangent_spec``) instead of in the tail bundle —
+    the structure XLA's latency-hiding scheduler needs to overlap grad
+    comms with the next layer's backward compute. Dropping the leading
+    entry of each stacked spec is exactly the layer-slice layout (the
+    stack axis disappears).
+
+    None for every other shape: fsdp/zero3 grads already equal the param
+    layout (the tail constraint pins them), ddp has nothing to scatter,
+    and pipeline schedules run their loss inside a partially-manual
+    shard_map where GSPMD constraints don't apply. Leaves whose shard
+    landed on the stacked LAYERS axis (spec[0] non-None — the chooser's
+    fallback when no in-layer axis divides) are skipped: their per-layer
+    slice is genuinely replicated, and pinning it mid-backward would add
+    a gather/scatter round-trip per layer instead of hiding one; the
+    tail constraint still places them.
+    """
+    if not (strategy.shard_grads and not strategy.shard_params
+            and not pipelined):
+        return None
+    per_block = tuple(sorted(
+        (name, P(*list(spec)[1:]))
+        for name, spec in grad_sharded_specs["blocks"].items()
+        if list(spec)[0] is None
+    ))
+    return per_block or None
+
+
 def make_train_step(
     model_config: tinygpt.TinyGPTConfig,
     strategy: strat.StrategyConfig,
@@ -161,6 +198,10 @@ def make_train_step(
                 f"unknown pipeline schedule {pipeline_schedule!r} "
                 "(expected 'gpipe', '1f1b' or 'interleaved')"
             )
+
+    block_spec = zero2_block_grad_spec(strategy, grad_sharded_specs, pipelined)
+    if block_spec is not None:
+        cfg = dataclasses.replace(cfg, block_grad_spec=block_spec)
 
     def train_step(params, opt_state, batch, step):
         if from_table:
@@ -230,8 +271,19 @@ def make_train_step(
             loss = loss_sum / grad_accum
             grads = jax.tree.map(lambda g: g / grad_accum, grads)
 
-        if strategy.shard_grads and not strategy.shard_params:
-            # ZeRO-2: reduce-scatter the gradients into the optimizer shard.
+        if strategy.shard_grads:
+            # Pin the gradient layout for every sharded-grad strategy.
+            # For zero2 this IS the semantics (reduce-scatter into the
+            # optimizer shard; the per-BLOCK half is issued inside the
+            # backward layer loop via cfg.block_grad_spec so each layer's
+            # grad comms can overlap the next layer's backward compute).
+            # For fsdp/zero3 the target equals the param layout and the
+            # constraint looks redundant — but under the composed dp x tp
+            # mesh it is load-bearing: without it GSPMD picks its own
+            # layout for the stacked grad carry in the backward scan and
+            # reconciles at the optimizer boundary with permute+all-to-all
+            # chains (measured on llama-fsdp-dp4-tp2-scan: 12 -> 4
+            # replication-reshard suspects from this line alone).
             grads = lax.with_sharding_constraint(grads, strat.named(mesh, grad_sharded_specs))
 
         if strategy.offload_opt_state:

@@ -361,6 +361,12 @@ class BenchmarkResult:
     # Across rank-sibling traces / device lanes: how far the slowest
     # lane's median step sits above the fastest's (percent).
     straggler_skew_pct: Optional[float] = None
+    # Scheduling-relevant XLA_FLAGS subset (utils.platform
+    # .scheduler_flags_fingerprint) — "" for the default lineage. Run
+    # identity: the latency-hiding scheduler changes the collective
+    # schedule, so flagged and unflagged runs must never cross-gate in the
+    # regress registry (store.config_key includes this field).
+    xla_scheduler_flags: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -420,6 +426,11 @@ def compute_result(
     n_anomalies: int = 0,
     step_anatomy: Optional[Dict[str, Any]] = None,
 ) -> BenchmarkResult:
+    def _scheduler_flags() -> str:
+        from . import platform as platform_mod
+
+        return platform_mod.scheduler_flags_fingerprint()
+
     mean_step = sum(step_times) / len(step_times) if step_times else 0.0
     mean_loss = sum(losses) / len(losses) if losses else 0.0
     # Descent endpoints: window of up to 10 steps, at most a fifth of the
@@ -550,6 +561,7 @@ def compute_result(
         time_in_checkpoint_sec=round(pt.get("checkpoint", 0.0), 4),
         time_in_trace_sec=round(pt.get("trace", 0.0), 4),
         n_anomalies=n_anomalies,
+        xla_scheduler_flags=_scheduler_flags(),
         **anatomy_fields,
     )
 

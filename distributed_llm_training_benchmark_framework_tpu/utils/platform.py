@@ -17,6 +17,7 @@ added exactly once.
 from __future__ import annotations
 
 import os
+import re
 from typing import Optional
 
 
@@ -81,6 +82,104 @@ def device_peak_flops(device_kind: str) -> Optional[float]:
 
     peak_t = flops_mod.device_peak_tflops(device_kind)
     return peak_t * 1e12 if peak_t is not None else None
+
+
+# ---------------------------------------------------------------------------
+# Latency-hiding scheduler flags (round 8, overlap work)
+# ---------------------------------------------------------------------------
+
+#: XLA flags that turn on the latency-hiding scheduler + async collectives
+#: on TPU — the compiler half of the zero2 per-block reduce-scatter overlap
+#: (the model half is tinygpt.block_grad_spec). One canonical tuple so the
+#: harness (--xla-latency-hiding), the entrypoint (XLA_LATENCY_HIDING=1)
+#: and the docs all name the same set. TPU-only: XLA aborts on unknown
+#: flags, so :func:`apply_latency_hiding_flags` gates the append on
+#: :func:`tpu_xla_plausible` (a CPU dryrun warns and no-ops).
+LATENCY_HIDING_XLA_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+)
+
+#: XLA_FLAGS tokens that change the collective schedule: these join the
+#: run's env fingerprint AND the registry config key (a flagged run is a
+#: different measurement lineage than an unflagged one — regress.store).
+_SCHEDULER_FLAG_RE = re.compile(
+    r"--xla\S*(?:latency_hiding|async_collective|overlap_compute"
+    r"|collective_scheduler|scheduling)\S*"
+)
+
+
+def tpu_xla_plausible() -> bool:
+    """True when the process can plausibly parse TPU-targeting XLA flags.
+
+    XLA ABORTS the process on unknown flags in ``XLA_FLAGS`` (a fatal
+    check in parse_flags_from_env.cc, not a warning), and the
+    latency-hiding set is ``--xla_tpu_*`` — unknown to a CPU/GPU-only
+    jaxlib. So: apply only when ``JAX_PLATFORMS``/``JAX_PLATFORM_NAME``
+    names a tpu-like platform, or (platform unforced) a TPU plugin is
+    importable. A forced-CPU env (the dryrun/test path) always skips.
+    """
+    env = (os.environ.get("JAX_PLATFORMS")
+           or os.environ.get("JAX_PLATFORM_NAME") or "").lower()
+    if "tpu" in env:
+        return True
+    if env:  # explicitly forced to cpu/gpu/axon/... — not our flag set
+        return False
+    import importlib.util
+
+    try:
+        return (importlib.util.find_spec("libtpu") is not None
+                or importlib.util.find_spec("jax_plugins.libtpu")
+                is not None)
+    except (ImportError, ValueError):
+        return False
+
+
+def apply_latency_hiding_flags() -> str:
+    """Append :data:`LATENCY_HIDING_XLA_FLAGS` to ``XLA_FLAGS`` (idempotent).
+
+    Must run BEFORE jax initializes its backend — callers are the harness
+    and bench.py flag handlers, which run it next to
+    :func:`honor_jax_platforms_env`. Returns the resulting ``XLA_FLAGS``.
+
+    On a host whose XLA cannot know the TPU flag set
+    (:func:`tpu_xla_plausible` False) this warns and no-ops instead of
+    letting XLA's unknown-flag check abort the process — the run then
+    records an empty ``xla_scheduler_flags`` fingerprint and stays in
+    the unflagged regress lineage, so the degrade is never silent in
+    the registry.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if not tpu_xla_plausible():
+        import sys
+
+        print(
+            "WARNING: --xla-latency-hiding skipped: no TPU platform/plugin "
+            "visible, and XLA aborts on unknown --xla_tpu_* flags "
+            "(xla_scheduler_flags stays empty for this run)",
+            file=sys.stderr,
+        )
+        return flags
+    present = set(flags.split())
+    missing = [f for f in LATENCY_HIDING_XLA_FLAGS if f not in present]
+    if missing:
+        flags = (flags + " " + " ".join(missing)).strip()
+        os.environ["XLA_FLAGS"] = flags
+    return flags
+
+
+def scheduler_flags_fingerprint(flags: Optional[str] = None) -> str:
+    """The scheduling-relevant subset of ``XLA_FLAGS``, sorted and joined.
+
+    Empty string when none are set — the default lineage. Recorded into
+    every result row (``xla_scheduler_flags``) so the regress registry can
+    keep flagged and unflagged lineages apart (store.config_key).
+    """
+    if flags is None:
+        flags = os.environ.get("XLA_FLAGS", "")
+    return " ".join(sorted(set(_SCHEDULER_FLAG_RE.findall(flags))))
 
 
 def allreduce_promotion_disabled(flags: str) -> bool:

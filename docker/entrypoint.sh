@@ -106,6 +106,12 @@ export CHECKPOINT_ASYNC="${CHECKPOINT_ASYNC:-0}"
 # partial_<arm>.json when a pod dies before the final result marker.
 export TELEMETRY="${TELEMETRY:-}"
 export HEARTBEAT_SEC="${HEARTBEAT_SEC:-}"
+# Overlap round 2 (docs/PERFORMANCE.md): 1 = turn on XLA's latency-hiding
+# scheduler + async collective fusion before backend init. The flag set is
+# recorded in the result row (xla_scheduler_flags) and keys a separate
+# regress lineage, so flagged pods never cross-gate against unflagged
+# history.
+export XLA_LATENCY_HIDING="${XLA_LATENCY_HIDING:-0}"
 
 echo "Config:"
 for v in STRATEGY WORLD_SIZE NUM_PROCESSES RANK MASTER_ADDR MASTER_PORT \
@@ -189,6 +195,8 @@ if [ "${FLASH_PALLAS_BACKWARD}" = "1" ]; then
 if [ "${FLASH_BLOCKWISE_BACKWARD}" = "1" ]; then
   ARGS="${ARGS} --flash-blockwise-backward"; fi
 if [ "${RESUME}" = "1" ]; then ARGS="${ARGS} --resume"; fi
+if [ "${XLA_LATENCY_HIDING}" = "1" ]; then
+  ARGS="${ARGS} --xla-latency-hiding"; fi
 if [ "${DEBUG}" = "1" ]; then ARGS="${ARGS} --debug"; fi
 if [ "${CHECKPOINT_ASYNC}" = "1" ]; then ARGS="${ARGS} --checkpoint-async"; fi
 if [ -n "${INJECT_FAULT}" ]; then

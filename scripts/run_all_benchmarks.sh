@@ -122,6 +122,13 @@ ARM_CHECKPOINT_EVERY="${ARM_CHECKPOINT_EVERY:-auto}"
 # including dirs from earlier or manual runs — into
 # $SUMMARY/step_anatomy.txt and ships it into BENCHMARK_REPORT.md.
 PROFILE="${PROFILE:-0}"
+# Remat/HBM frontier (bench.py --remat-sweep, docs/PERFORMANCE.md):
+# REMAT_SWEEP=1 re-runs the flagship configuration once per remat policy
+# after the matrix, ingests one registry record per policy (the policy is
+# part of the config key, so each is its own lineage) and refreshes the
+# report so the frontier table lands in BENCHMARK_REPORT.md. Local mode
+# only — the sweep is a bench.py in-process run, not a pod matrix.
+REMAT_SWEEP="${REMAT_SWEEP:-0}"
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -432,6 +439,35 @@ if [ "$SKIP_REGRESS" != "1" ]; then
   python -m distributed_llm_training_benchmark_framework_tpu.analysis.make_report \
     --csv "$SUMMARY/metrics.csv" --out "$SUMMARY" --plots-dir ../plots \
     --registry "$REGISTRY_DIR" $STEP_ANATOMY_FLAG || true
+fi
+
+if [ "$REMAT_SWEEP" = "1" ] && [ "$MODE" != "local" ]; then
+  echo "NOTE: REMAT_SWEEP=1 only runs in local mode (the sweep is an" \
+       "in-process bench.py run, not a pod matrix) — skipping it in" \
+       "mode '$MODE'"
+fi
+if [ "$REMAT_SWEEP" = "1" ] && [ "$MODE" = "local" ]; then
+  echo ""
+  echo "=== Remat/HBM frontier sweep (registry: $REGISTRY_DIR) ==="
+  # The sweep arms ride the suite's run length; --flagship off because
+  # the sweep's 'none' point IS the flagship configuration. The records
+  # land in the registry (--regress on creates it if needed) and the
+  # report refresh below renders the frontier table from them.
+  if python bench.py --remat-sweep --flagship off --skip-preflight \
+       --steps "$STEPS" --warmup-steps "$WARMUP_STEPS" \
+       --sync-every "$SYNC_EVERY" \
+       --regress on --registry "$REGISTRY_DIR" \
+       > "$RESULTS_DIR/remat_sweep.json" 2> "$RESULTS_DIR/remat_sweep.log"
+  then
+    python -m distributed_llm_training_benchmark_framework_tpu.analysis.make_report \
+      --csv "$SUMMARY/metrics.csv" --out "$SUMMARY" --plots-dir ../plots \
+      --registry "$REGISTRY_DIR" $STEP_ANATOMY_FLAG || true
+    echo "frontier records + report refreshed ($RESULTS_DIR/remat_sweep.json)"
+  else
+    echo "REMAT SWEEP FAILED — last 20 log lines:"
+    tail -20 "$RESULTS_DIR/remat_sweep.log" || true
+    FAIL=$((FAIL+1))
+  fi
 fi
 
 echo ""

@@ -1,0 +1,71 @@
+"""Regenerate ``tests/fixtures/registry_frozen_remat/`` deterministically.
+
+Four un-ingested registry records — one per ``bench.py --remat-sweep``
+policy — built through the REAL construction path
+(``store.record_from_bench_row`` on sweep-shaped contract rows, exactly
+what ``bench.registry_rows`` hands ``bench.record_in_registry``), then
+frozen with a fixed env fingerprint like the other registry fixtures.
+``test_regress.py`` ingests them into a scratch registry and pins the
+``make_report`` remat/HBM frontier table rendered from them.
+
+    python tests/fixtures/make_remat_frozen.py
+
+Byte-identical by construction (fixed values, fixed env).
+"""
+
+import json
+import os
+
+from distributed_llm_training_benchmark_framework_tpu.regress import (
+    store as rstore,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "registry_frozen_remat")
+
+#: policy -> (tokens/sec/chip, resolved policy, peak HBM GB, headroom GB,
+#: MFU %). The shape of a real v5e sweep: remat trades tokens/sec for
+#: HBM headroom monotonically; 'auto' probes its way to 'dots' here.
+SWEEP = {
+    "none": (41900.0, "none", 12.4, 3.6, 38.4),
+    "dots": (40100.0, "dots", 9.8, 6.2, 36.8),
+    "full": (36400.0, "full", 7.1, 8.9, 33.4),
+    "auto": (40050.0, "dots", 9.8, 6.2, 36.7),
+}
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    for pol, (tps, resolved, hbm, headroom, mfu) in SWEEP.items():
+        row = {
+            "metric": "llama_tierA_seq2048_tokens_per_sec_per_chip",
+            "value": tps, "unit": "tokens/sec/chip", "vs_baseline": 9.1,
+            "attention_impl": "flash", "dropout": None,
+            "model_family": "llama", "per_device_batch": 2,
+            "grad_accum": 2, "layer_loop": "unrolled",
+            "steps": 100, "warmup_steps": 5, "sync_every": 10,
+            "strategy": "zero2", "tier": "A", "seq_len": 2048,
+            "mfu_pct": mfu, "peak_hbm_gb": hbm,
+            "remat_policy": pol, "remat_policy_resolved": resolved,
+            "hbm_headroom_gb": headroom,
+        }
+        rec = rstore.record_from_bench_row(
+            row, source=f"bench.py:remat-sweep:{pol}",
+        )
+        rec["env"] = {
+            "git_sha": "f0f0f0f", "jax_version": "0.0-frozen",
+            "device_kind": "TPU v5 lite", "backend": "tpu",
+            "attention_impl": "flash", "xla_scheduler_flags": "",
+            "mesh": {"world_size": 1, "tensor_parallel": 1,
+                     "sequence_parallel": 1, "pipeline_parallel": 1,
+                     "expert_parallel": 1},
+        }
+        path = os.path.join(OUT, f"record_remat_{pol}.json")
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

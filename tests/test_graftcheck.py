@@ -115,7 +115,8 @@ def test_lint_is_clean_on_head():
 
 def test_rule_catalog_is_complete():
     assert set(lint.RULES) == {
-        "GC101", "GC102", "GC103", "GC104", "GC105", "GC106", "GC201",
+        "GC101", "GC102", "GC103", "GC104", "GC105", "GC106", "GC107",
+        "GC201",
     }
     for rule in lint.RULES.values():
         assert rule.fix_hint and rule.description
@@ -346,6 +347,61 @@ def test_gc104_fires_on_time_time(tmp_path):
     assert [v.line for v in violations] == [4]
 
 
+def test_gc107_fires_on_dtypeless_constructors(tmp_path):
+    root = _scratch_root(tmp_path, "models/scratch.py", """\
+        import jax.numpy as jnp
+
+        def bad_asarray(x):
+            return jnp.asarray(x) * x
+
+        def bad_ones(s):
+            return jnp.ones(s)
+
+        def bad_full(s):
+            return jnp.full(s, 0.5)
+
+        def fine_kwarg(x):
+            return jnp.asarray(x, dtype=jnp.bfloat16)
+
+        def fine_positional(s, dt):
+            return jnp.zeros(s, dt)
+
+        def fine_full_positional(s, dt):
+            return jnp.full(s, 0.5, dt)
+
+        def sanctioned(x):
+            return jnp.asarray(x)  # graftcheck: disable=GC107
+    """)
+    violations = lint.run_lint(root=root, rules=("GC107",))
+    assert [v.line for v in violations] == [4, 7, 10]
+    assert all(v.rule_id == "GC107" for v in violations)
+    assert "dtype=" in violations[0].fix_hint
+
+
+def test_gc107_scope_is_models_and_train_step(tmp_path):
+    # The same dtype-less constructor outside jitted model code (analysis,
+    # telemetry, train/loop.py host orchestration) is host-side
+    # bookkeeping — out of scope; train/step.py (the jitted step) is in.
+    src = """\
+        import jax.numpy as jnp
+
+        def host_side(x):
+            return jnp.asarray(x)
+    """
+    out_root = _scratch_root(tmp_path / "out", "analysis/scratch.py", src)
+    _scratch_root(tmp_path / "out", "train/loop.py", src)
+    assert lint.run_lint(root=out_root, rules=("GC107",)) == []
+    in_root = _scratch_root(tmp_path / "in", "train/step.py", src)
+    violations = lint.run_lint(root=in_root, rules=("GC107",))
+    assert [(v.path, v.line) for v in violations] == [
+        (os.path.join(PKG, "train", "step.py"), 4)
+    ]
+
+
+def test_gc107_clean_on_head():
+    assert lint.run_lint(rules=("GC107",)) == []
+
+
 def test_suppression_accepts_lists_and_all(tmp_path):
     root = _scratch_root(tmp_path, "models/scratch.py", """\
         import jax
@@ -392,6 +448,89 @@ def test_roster_covers_strategy_family_and_geometry_axes():
         "configs/collective_budgets.json out of sync with the roster — "
         "run --update-budgets"
     )
+
+
+def test_budget_pins_fsdp_dp4_tp2_fallback_dead():
+    """The round-8 acceptance pin: the banked llama-fsdp-dp4-tp2 fallback
+    is GONE from the frozen budgets — 13 replication-reshard suspects
+    (collective-permutes in a pure dp x tp mesh) -> 0, permute/all-to-all
+    counts 0. The scan sibling banks its residual scan-carry fallback
+    explicitly so it cannot grow unnoticed."""
+    budgets = hlo_audit.load_budgets()
+    arm = budgets["arms"]["llama-fsdp-dp4-tp2"]
+    assert arm["replication_reshard_suspects"] == 0
+    assert arm["collectives"]["collective-permute"] == 0
+    assert arm["collectives"]["all-to-all"] == 0
+    scan = budgets["arms"]["llama-fsdp-dp4-tp2-scan"]
+    assert scan["replication_reshard_suspects"] == 4  # banked scan-carry
+
+
+def test_injection_registry_covers_bad_fsdp_axis():
+    assert set(hlo_audit._INJECTIONS) == {"bad-kv-spec", "bad-fsdp-axis"}
+
+
+def test_bad_fsdp_axis_injection_reverts_composed_placement(eight_devices):
+    """Spec-level proof of the --inject bad-fsdp-axis mechanism (the
+    compile-level exit-1 proof is the CLI run in docs/PERFORMANCE.md):
+    under the composed dp4 x tp2 mesh the hygiene rules keep 'data' off
+    every axis AFTER a leaf's 'model' axis (row-parallel/vocab leaves:
+    wo/wproj/wte/lm_head) and off vector-like leaves; the injection
+    reverts both, reintroducing the transposed-tile-order placement whose
+    reshard chains were the 13 banked collective-permutes."""
+    import functools
+
+    import jax
+
+    from distributed_llm_training_benchmark_framework_tpu.models import (
+        tinygpt as tg,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.models.llama import (
+        get_llama_config,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        strategies as strat,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    cfg = get_llama_config("S", 64, dropout=0.0)
+    mesh = make_mesh((4, 1, 2), ("data", "seq", "model"),
+                     devices=jax.devices())
+    shapes = jax.eval_shape(
+        functools.partial(tg.init_params, cfg), jax.random.key(0)
+    )
+
+    def leaf_specs():
+        specs = strat.param_partition_specs(
+            shapes, mesh, shard=True, kv_heads=cfg.kv_heads
+        )
+        flat = jax.tree_util.tree_flatten_with_path(specs)[0]
+        return {
+            "/".join(str(getattr(p, "key", p)) for p in path): tuple(spec)
+            for path, spec in flat
+        }
+
+    def data_after_model(spec):
+        return ("model" in spec and "data" in spec
+                and spec.index("data") > spec.index("model"))
+
+    clean = leaf_specs()
+    assert not any(data_after_model(s) for s in clean.values()), clean
+    # Row-parallel leaves keep model-only sharding; vector-like leaves
+    # stay replicated over 'data'; column-parallel leaves keep the split.
+    assert "data" not in clean["blocks/wo"]
+    assert "data" not in clean["lm_head"]
+    assert clean["blocks/ln1_scale"] == (None, None)
+    assert "data" in clean["blocks/wq"]
+
+    injected = hlo_audit._with_bad_fsdp_axis(leaf_specs)
+    bad = [n for n, s in injected.items() if data_after_model(s)]
+    assert "blocks/wo" in bad and "lm_head" in bad, injected
+    assert "data" in injected["blocks/ln1_scale"]
+    # The escape hatch restored the hygiene flag on the way out.
+    assert strat._COMPOSED_FSDP_HYGIENE is True
+    assert leaf_specs() == clean
 
 
 def test_injected_bad_kv_spec_is_flagged(gqa_report, eight_devices):

@@ -1,0 +1,307 @@
+"""Overlap round 2 (PR 8) coverage: the zero2 per-block grad-comms path,
+the latency-hiding scheduler flag plumbing, and the registry lineage
+separation for scheduler-flagged / remat-swept runs.
+
+Three layers:
+
+- model/step units: ``tinygpt._with_cotangent_spec`` constrains the
+  COTANGENT (the gradient adopts its ZeRO-2 placement inside the backward
+  layer loop), and ``make_train_step`` arms ``block_grad_spec`` exactly
+  for sharded-grad/replicated-param (zero2-shaped) strategies;
+- an HLO-level pin that the zero2 arm's gradient collectives lower
+  INTERLEAVED with backward compute (not one tail bundle) — the
+  structural property the latency-hiding scheduler needs to overlap them;
+- platform/registry units: ``apply_latency_hiding_flags`` is idempotent,
+  ``scheduler_flags_fingerprint`` extracts exactly the scheduling flags,
+  and the A/A proof that ``xla_scheduler_flags`` / ``remat_policy`` join
+  the regress config key so flagged/unflagged (and per-policy) lineages
+  never cross-gate.
+"""
+
+import dataclasses
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_llm_training_benchmark_framework_tpu.analysis.static import (
+    hlo_audit,
+)
+from distributed_llm_training_benchmark_framework_tpu.models import tinygpt
+from distributed_llm_training_benchmark_framework_tpu.parallel.mesh import (
+    make_mesh,
+)
+from distributed_llm_training_benchmark_framework_tpu.regress import (
+    store as rstore,
+)
+from distributed_llm_training_benchmark_framework_tpu.utils import (
+    platform as platform_mod,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Model/step units: the cotangent-spec hook
+# ---------------------------------------------------------------------------
+
+
+def test_with_cotangent_spec_is_identity_forward(eight_devices):
+    x = jnp.arange(8.0).reshape(2, 4)
+    y = tinygpt._with_cotangent_spec(P("data"), x)
+    assert (y == x).all()
+
+
+def test_with_cotangent_spec_constrains_the_cotangent(eight_devices):
+    """The whole point of the hook: the CONSTRAINT lands on the gradient,
+    inside the backward — visible as a sharding_constraint eqn in the
+    grad jaxpr (the forward stays constraint-free)."""
+    mesh = make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    spec = P("data")
+
+    def f(x):
+        y = tinygpt._with_cotangent_spec(spec, x)
+        return (y * y).sum()
+
+    x = jnp.ones((8, 4))
+    with mesh:
+        fwd = str(jax.make_jaxpr(f)(x))
+        bwd = str(jax.make_jaxpr(jax.grad(f))(x))
+    assert "sharding_constraint" not in fwd
+    assert "sharding_constraint" in bwd
+
+
+def test_constrain_layer_grads_wraps_only_spec_leaves():
+    cfg = tinygpt.get_model_config("S", 64)
+    cfg = dataclasses.replace(
+        cfg, block_grad_spec=(("wq", P("data")),)
+    )
+    layer = {"wq": jnp.ones((4, 4)), "wo": jnp.ones((4, 4))}
+    out = tinygpt._constrain_layer_grads(cfg, layer)
+    # Identity values either way; the wq leaf went through the custom-vjp
+    # identity (same values), wo passed through untouched (same object).
+    assert (out["wq"] == layer["wq"]).all()
+    assert out["wo"] is layer["wo"]
+    # No spec -> exact passthrough.
+    assert tinygpt._constrain_layer_grads(
+        dataclasses.replace(cfg, block_grad_spec=None), layer
+    ) is layer
+
+
+# ---------------------------------------------------------------------------
+# HLO-level pin: zero2 grad collectives interleave with backward compute
+# ---------------------------------------------------------------------------
+
+
+ZERO2_UNROLLED = hlo_audit.ArmSpec(
+    "zero2-dp4-unrolled", "zero2", (4,), ("data",),
+    global_batch=4, model_family="tinygpt",
+    config_overrides=(("scan_layers", False),),
+)
+
+
+@pytest.fixture(scope="module")
+def zero2_hlo(eight_devices):
+    return hlo_audit.lower_arm(ZERO2_UNROLLED).as_text()
+
+
+def _grad_collective_and_dot_lines(txt):
+    lines = txt.splitlines()
+    colls = [i for i, l in enumerate(lines)
+             if re.search(r"= \S+ (all-reduce|reduce-scatter)", l)]
+    dots = [i for i, l in enumerate(lines)
+            if re.search(r"= \S+ dot\(", l)]
+    return colls, dots
+
+
+def test_zero2_grad_comms_interleave_not_tail_bundle(zero2_hlo):
+    """Round-8 overlap shape: the zero2 arm's gradient reduce-scatters
+    (lowered as all-reduce+slice on the CPU backend) must appear
+    INTERLEAVED with the backward's dot ops in the optimized module, not
+    as one bundle after the last dot — a tail bundle is unoverlappable
+    no matter what the scheduler does. Regressing the per-block grad
+    placement (tinygpt.block_grad_spec / the step's grad constraint)
+    shows up here as the collectives sinking past the final dot."""
+    colls, dots = _grad_collective_and_dot_lines(zero2_hlo)
+    assert colls, "zero2 arm lowered no gradient collectives at all?"
+    assert dots
+    last_dot = max(dots)
+    interleaved = [i for i in colls if i < last_dot]
+    assert len(interleaved) >= len(colls) // 2, (
+        f"only {len(interleaved)}/{len(colls)} grad collectives appear "
+        "before the last backward dot — the grad comms have collapsed "
+        "into a tail bundle"
+    )
+
+
+def test_zero2_shape_arms_block_grad_spec(eight_devices):
+    """The step arms the per-layer-slice grad placement exactly for the
+    zero2 shape (sharded grads, replicated params, no pipeline) —
+    fsdp/zero3 keep the param-equal layout via the tail constraint, ddp
+    has nothing to scatter, pipeline schedules keep the tail path."""
+    import functools
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+        strategies as strat,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.step import (
+        zero2_block_grad_spec,
+    )
+
+    mesh = make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    cfg = tinygpt.get_model_config("S", 64)
+    params_shape = jax.eval_shape(
+        functools.partial(tinygpt.init_params, cfg), jax.random.key(0)
+    )
+    specs = strat.param_partition_specs(
+        params_shape, mesh, shard=True, kv_heads=cfg.kv_heads,
+    )
+    armed = zero2_block_grad_spec(get_strategy("zero2"), specs, False)
+    assert armed, "zero2 must arm the per-block grad placement"
+    names = dict(armed)
+    assert set(names) == set(specs["blocks"])
+    for name, spec in armed:
+        # The layer-slice spec is the stacked spec minus its layers axis.
+        assert tuple(spec) == tuple(specs["blocks"][name])[1:]
+    # A leaf whose shard fell back to the stacked LAYERS axis is skipped:
+    # its per-layer slice is replicated, and pinning that mid-backward
+    # would ADD a per-layer round-trip instead of hiding one.
+    forced = {**specs, "blocks": {**specs["blocks"], "wq": P("data")}}
+    armed_forced = zero2_block_grad_spec(get_strategy("zero2"), forced, False)
+    assert "wq" not in dict(armed_forced)
+    only_layer_axis = {
+        **specs,
+        "blocks": {k: P("data") for k in specs["blocks"]},
+    }
+    assert zero2_block_grad_spec(
+        get_strategy("zero2"), only_layer_axis, False
+    ) is None  # nothing armable -> no config change at all
+    assert zero2_block_grad_spec(get_strategy("ddp"), specs, False) is None
+    assert zero2_block_grad_spec(get_strategy("fsdp"), specs, False) is None
+    assert zero2_block_grad_spec(get_strategy("zero3"), specs, False) is None
+    # Pipeline runs keep the tail path even for zero2.
+    assert zero2_block_grad_spec(get_strategy("zero2"), specs, True) is None
+
+
+# ---------------------------------------------------------------------------
+# Platform units: the latency-hiding flag set
+# ---------------------------------------------------------------------------
+
+
+def test_apply_latency_hiding_flags_is_idempotent(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_foo=1")
+    first = platform_mod.apply_latency_hiding_flags()
+    assert "--xla_foo=1" in first
+    for f in platform_mod.LATENCY_HIDING_XLA_FLAGS:
+        assert f in first.split()
+    second = platform_mod.apply_latency_hiding_flags()
+    assert second == first  # no duplicate appends
+    assert os.environ["XLA_FLAGS"] == first
+
+
+def test_apply_latency_hiding_flags_skips_without_tpu(monkeypatch, capsys):
+    """XLA ABORTS the whole process on unknown flags in XLA_FLAGS, and
+    the latency-hiding set is --xla_tpu_*: on a forced-CPU host the
+    apply must warn and no-op (leaving the unflagged lineage intact),
+    never let the fatal unknown-flag check fire."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_foo=1")
+    out = platform_mod.apply_latency_hiding_flags()
+    assert out == "--xla_foo=1"
+    assert os.environ["XLA_FLAGS"] == "--xla_foo=1"
+    assert "skipped" in capsys.readouterr().err
+    # Any tpu-like forced platform (incl. multi-platform lists) applies.
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    assert platform_mod.tpu_xla_plausible() is True
+    # Another forced accelerator plugin is not our flag set either.
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert platform_mod.tpu_xla_plausible() is False
+
+
+def test_scheduler_flags_fingerprint_extracts_scheduling_subset():
+    flags = ("--xla_force_host_platform_device_count=8 "
+             "--xla_tpu_enable_latency_hiding_scheduler=true "
+             "--xla_tpu_enable_async_collective_fusion=true")
+    fp = platform_mod.scheduler_flags_fingerprint(flags)
+    assert "latency_hiding" in fp and "async_collective" in fp
+    assert "host_platform_device_count" not in fp
+    # Sorted + deduped: order/duplication in XLA_FLAGS cannot fork lineages.
+    assert fp == platform_mod.scheduler_flags_fingerprint(
+        " ".join(reversed(fp.split())) + " " + fp
+    )
+    assert platform_mod.scheduler_flags_fingerprint("") == ""
+
+
+def test_full_flag_set_fingerprint_covers_every_flag():
+    fp = platform_mod.scheduler_flags_fingerprint(
+        " ".join(platform_mod.LATENCY_HIDING_XLA_FLAGS)
+    )
+    assert set(fp.split()) == set(platform_mod.LATENCY_HIDING_XLA_FLAGS)
+
+
+def test_harness_and_entrypoint_carry_the_flag():
+    from distributed_llm_training_benchmark_framework_tpu.train.harness import (
+        build_parser,
+    )
+
+    flags = {o for a in build_parser()._actions for o in a.option_strings}
+    assert "--xla-latency-hiding" in flags
+    entry = open(os.path.join(REPO, "docker", "entrypoint.sh")).read()
+    assert "XLA_LATENCY_HIDING" in entry
+    assert "--xla-latency-hiding" in entry
+    # bench.py stamps the fingerprint into its contract rows (additive,
+    # only when flags are live) — without this a flagged bench run would
+    # land in the unflagged regress lineage.
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    assert "--xla-latency-hiding" in bench_src
+    assert 'row_extra["xla_scheduler_flags"]' in bench_src
+
+
+# ---------------------------------------------------------------------------
+# Registry lineage: scheduler flags + remat policy join the config key
+# ---------------------------------------------------------------------------
+
+
+def _rec(**row):
+    base = {
+        "metric": "tinygpt_tierA_seq2048_tokens_per_sec_per_chip",
+        "value": 41000.0, "strategy": "zero2", "tier": "A",
+        "seq_len": 2048, "steps": 100, "warmup_steps": 5,
+    }
+    base.update(row)
+    return rstore.record_from_bench_row(base, source="test")
+
+
+def test_scheduler_flags_join_config_key_aa():
+    """A/A: identical measurements with and without the scheduler flags
+    are DIFFERENT lineages — the flag changes the collective schedule, so
+    cross-gating them would verdict a compiler change as a perf delta.
+    Legacy rows (no field) stay in the unflagged lineage."""
+    plain = _rec()
+    flagged = _rec(xla_scheduler_flags=" ".join(
+        platform_mod.LATENCY_HIDING_XLA_FLAGS
+    ))
+    same = _rec()
+    assert rstore.config_key(plain) == rstore.config_key(same)
+    assert rstore.config_key(plain) != rstore.config_key(flagged)
+    # Legacy record (field absent) == unflagged lineage.
+    legacy = _rec()
+    legacy["result"].pop("xla_scheduler_flags", None)
+    assert rstore.config_key(legacy) == rstore.config_key(plain)
+    # The flags are triage-visible in the env fingerprint too.
+    assert flagged["env"]["xla_scheduler_flags"] != ""
+
+
+def test_remat_policy_joins_config_key_per_policy():
+    keys = {
+        pol: rstore.config_key(_rec(remat_policy=pol))
+        for pol in ("none", "dots", "full", "auto")
+    }
+    assert len(set(keys.values())) == 4
+    # Absent (ordinary bench/flagship rows) is its own lineage as well.
+    assert rstore.config_key(_rec()) not in set(keys.values())

@@ -592,6 +592,141 @@ def test_make_report_trend_section(frozen_registry, tmp_path):
     assert "Per-arm trend" not in make_report.build_report(df)
 
 
+FROZEN_REMAT = os.path.join(FIXTURES, "registry_frozen_remat")
+
+
+@pytest.fixture()
+def remat_registry(tmp_path):
+    """A scratch registry holding the frozen --remat-sweep records (one
+    per policy; regenerate with tests/fixtures/make_remat_frozen.py)."""
+    reg = rstore.Registry(str(tmp_path / "reg"))
+    for pol in ("none", "dots", "full", "auto"):
+        rec = json.load(
+            open(os.path.join(FROZEN_REMAT, f"record_remat_{pol}.json"))
+        )
+        reg.ingest(rec)
+    return reg
+
+
+def test_make_report_remat_frontier_from_frozen_fixture(remat_registry):
+    """The ISSUE-8 acceptance pin: make_report renders the HBM-vs-
+    recompute frontier table from the frozen sweep records — one row per
+    policy in recompute order, resolved policy, delta vs the no-remat
+    point, peak HBM + per-chip headroom."""
+    import pandas as pd
+
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        make_report,
+    )
+
+    md = make_report.build_report(
+        pd.DataFrame([result_row()]), registry_root=remat_registry.root,
+    )
+    assert "## Remat/HBM frontier (`bench.py --remat-sweep`)" in md
+    assert "### bench_llama_tierA_seq2048" in md
+    lines = [l for l in md.splitlines() if l.startswith("|") and
+             any(f"| {p} |" in l for p in ("none", "dots", "full", "auto"))]
+    # Recompute order none -> dots -> full, the auto probe last.
+    assert [l.split("|")[1].strip() for l in lines] == [
+        "none", "dots", "full", "auto",
+    ]
+    assert "| none | none | 41,900.00 | +0.0% | 12.40 | 3.60 | 38.40 |" \
+        in md
+    assert "| full | full | 36,400.00 | -13.1% | 7.10 | 8.90 | 33.40 |" \
+        in md
+    assert "| auto | dots | 40,050.00 | -4.4% |" in md
+    # Registries without sweep records render no frontier section.
+    md_plain = make_report.build_report(
+        pd.DataFrame([result_row()]),
+        registry_root=os.path.join(FIXTURES, "registry_frozen"),
+    )
+    assert "Remat/HBM frontier" not in md_plain
+
+
+def test_remat_frontier_never_mixes_lineages(remat_registry):
+    """A later smoke-length sweep must not lend rows to (or borrow the
+    'none' base from) an older full-length sweep: the table renders the
+    NEWEST lineage only, counting omitted older-lineage records in a
+    visible note."""
+    import pandas as pd
+
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        make_report,
+    )
+
+    smoke = json.load(
+        open(os.path.join(FROZEN_REMAT, "record_remat_none.json"))
+    )
+    smoke["result"] = dict(smoke["result"], steps=12, value=9000.0)
+    smoke["metric"] = dict(smoke["metric"], value=9000.0)
+    smoke["record_id"] = rstore.record_id_for(smoke)
+    remat_registry.ingest(smoke)
+    md = make_report.build_report(
+        pd.DataFrame([result_row()]), registry_root=remat_registry.root,
+    )
+    # Only the smoke lineage's single row renders in the FRONTIER
+    # section (the registry trend section still lists every record)…
+    section = md.split("## Remat/HBM frontier")[1].split("\n## ")[0]
+    assert "| none | none | 9,000.00 |" in section
+    assert "41,900.00" not in section and "| full |" not in section
+    # …and the omission is named, never silent.
+    assert "4 older-lineage sweep record(s)" in section
+
+
+def test_remat_sweep_records_stay_separate_lineages(remat_registry):
+    """One record per policy, each its own config-key lineage (the
+    acceptance contract: a 'full' run can never gate against the 'none'
+    history), and the ordinary bench lineage excludes them all."""
+    reg = remat_registry
+    recs = reg.records("bench_llama_tierA_seq2048")
+    assert len(recs) == 4
+    keys = {r["result"]["remat_policy"]: rstore.config_key(r) for r in recs}
+    assert len(set(keys.values())) == 4
+    for rec in recs:
+        base = reg.baseline(
+            "bench_llama_tierA_seq2048",
+            exclude_record_id=rec["record_id"], match_config_of=rec,
+        )
+        assert base is None, (
+            f"{rec['result']['remat_policy']} found a cross-policy baseline"
+        )
+
+
+def test_bench_registry_rows_emit_one_row_per_sweep_policy():
+    """bench.registry_rows fans the remat_sweep sub-object into one
+    record per policy, tagged with its source and the flagship geometry,
+    while the headline row stays sweep-free."""
+    import bench
+
+    args = bench.build_parser().parse_args(["--remat-sweep"])
+    sweep_row = {
+        "metric": "llama_tierA_seq2048_tokens_per_sec_per_chip",
+        "value": 40000.0, "remat_policy": "none",
+        "remat_policy_resolved": "none", "hbm_headroom_gb": 3.6,
+    }
+    payload = {
+        "metric": "tinygpt_tierA_seq2048_tokens_per_sec_per_chip",
+        "value": 41500.0,
+        "remat_sweep": {
+            pol: dict(sweep_row, remat_policy=pol)
+            for pol in bench.REMAT_SWEEP_POLICIES
+        },
+    }
+    rows = bench.registry_rows(args, payload)
+    sources = [src for src, _row, _extra in rows]
+    assert sources[0] == "bench.py"
+    assert sorted(sources[1:]) == sorted(
+        f"bench.py:remat-sweep:{p}" for p in bench.REMAT_SWEEP_POLICIES
+    )
+    # The headline row never carries the sweep payload…
+    assert "remat_sweep" not in rows[0][1]
+    # …and each sweep row keeps its policy + gets the flagship geometry.
+    for src, row, extra in rows[1:]:
+        assert row["remat_policy"] == src.rsplit(":", 1)[1]
+        assert extra["model_family"] == bench.FLAGSHIP_FAMILY
+        assert extra["grad_accum"] == bench.FLAGSHIP_GRAD_ACCUM
+
+
 def test_bench_style_scalar_verdict(tmp_path):
     """bench.py's lineage: legacy seed -> a -10% headline run flags."""
     import bench
@@ -711,6 +846,22 @@ def test_suite_finish_path_has_gate_with_escape_hatch():
     assert "ingest --results-dir" in text
     assert "gate --all" in text
     assert "REGRESSION GATE FAILED" in text
+
+
+def test_suite_remat_sweep_opt_in_wiring():
+    """REMAT_SWEEP=1 appends the frontier sweep after the matrix: the
+    flagship-off bench.py sweep invocation, registry ingestion via
+    --regress on, and a report refresh so the frontier table lands in
+    BENCHMARK_REPORT.md (local mode only — the sweep is in-process)."""
+    text = open(
+        os.path.join(REPO, "scripts", "run_all_benchmarks.sh")
+    ).read()
+    assert 'REMAT_SWEEP="${REMAT_SWEEP:-0}"' in text
+    assert "--remat-sweep --flagship off" in text
+    assert '"$REMAT_SWEEP" = "1" ] && [ "$MODE" = "local"' in text
+    assert "REMAT SWEEP FAILED" in text
+    # The sweep block refreshes the report AFTER ingesting its records.
+    assert text.index("--remat-sweep") < text.rindex("make_report")
 
 
 def test_gate_script_end_to_end(frozen_registry):

@@ -149,6 +149,35 @@ def test_frozen_fixture_clip_is_load_bearing(tmp_path):
             < FROZEN_FIELDS["comms_exposed_frac"])
 
 
+def test_frozen_fixture_exposed_by_class_pinned():
+    """Round-8 satellite: exposed time split by collective class — the
+    table that names WHICH collective to overlap first. On the frozen
+    fixture the 2.0ms/step exposed time is 1.5ms all-reduce + 0.5ms
+    all-gather (x8 steps); the pipeline fixture's send/recv hops are
+    never hidden so each class exposes its full 1.5ms total."""
+    report = sa.analyze_profile_dir(TRACE_FROZEN)
+    assert report["agg"]["comms_exposed_by_class"] == [
+        ("all-reduce", 12000.0), ("all-gather", 4000.0),
+    ]
+    # The telemetry-event payload (train/loop.py rides it into the
+    # step_anatomy event): per-class exposed fraction OF THE STEP,
+    # most exposed first.
+    assert sa.exposed_by_class_fracs(report) == {
+        "all-reduce": 0.1478, "all-gather": 0.0493,
+    }
+    pp = sa.analyze_profile_dir(TRACE_FROZEN_PP)
+    assert pp["agg"]["comms_exposed_by_class"] == [
+        ("send", 1500.0), ("recv", 1500.0),
+    ]
+    # The loop forwards the split into the telemetry event stream.
+    loop_src = open(os.path.join(
+        REPO, "distributed_llm_training_benchmark_framework_tpu", "train",
+        "loop.py",
+    )).read()
+    assert "comms_exposed_by_class" in loop_src
+    assert "exposed_by_class_fracs" in loop_src
+
+
 def test_frozen_pipeline_bubble_pinned():
     report = sa.analyze_profile_dir(TRACE_FROZEN_PP)
     fields = sa.result_fields(report)
@@ -208,6 +237,8 @@ def test_cli_table_on_frozen_fixture(capsys):
     assert "comms (exposed)        2.000 ms   19.7%" in out
     assert "[overlap_frac 33.3% of collective time]" in out
     assert "idle / host gap        1.150 ms   11.3%" in out
+    assert ("exposed by class (per step): all-reduce 1.500 ms (75%), "
+            "all-gather 0.500 ms (25%)") in out
     assert "[clipped to telemetry timed region]" in out
     assert "straggler skew: 3.0% across 2 rank(s)" in out
     assert "25.0% of 197 peak" in out and "50.0% of 819 GB/s peak" in out
