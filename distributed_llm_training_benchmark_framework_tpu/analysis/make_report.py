@@ -346,6 +346,13 @@ def build_report(
         full = df[~df["partial"].fillna(False).astype(bool)]
     else:
         full = df
+    # Sentinel-healed rows (n_rollbacks > 0, self-healing round): complete
+    # and validated, but the run hit a numerics incident and replayed
+    # steps — show the column so the heal is visible in the table.
+    if "n_rollbacks" in df.columns and (
+        df["n_rollbacks"].fillna(0) > 0
+    ).any():
+        cols.append("n_rollbacks")
     cols = [c for c in cols if c in df.columns]
     out = ["# TPU Distributed Training Benchmark Report", ""]
 
@@ -407,17 +414,22 @@ def build_report(
     if has_partial:
         is_partial = df["partial"].fillna(False).astype(bool)
         n_partial = int(is_partial.sum())
-        # Preemption vs crash (chaos round): a preempted arm left an
-        # emergency checkpoint and resumes on retry; a crashed one needs
-        # triage. The collect script stamps `reason` from the final
-        # heartbeat (emergency heartbeats carry reason=preempted).
+        # Death classification (chaos + self-healing rounds): a preempted
+        # arm left an emergency checkpoint and resumes on retry; a hung
+        # arm was aborted by the in-process watchdog (exit 76, stack dump
+        # in its telemetry hang_dump event) and also resumes on retry; a
+        # crashed one needs triage. The collect script stamps `reason`
+        # from the final heartbeat (emergency heartbeats carry
+        # reason=preempted|hang).
         death = ""
         if "reason" in df.columns:
-            n_pre = int(
-                (df.loc[is_partial, "reason"] == "preempted").sum()
-            )
+            reasons = df.loc[is_partial, "reason"]
+            n_pre = int((reasons == "preempted").sum())
+            n_hang = int((reasons == "hang").sum())
             death = (f" ({n_pre} preempted with an emergency checkpoint, "
-                     f"{n_partial - n_pre} crashed)")
+                     f"{n_hang} hung (watchdog abort, stack dump in "
+                     "telemetry), "
+                     f"{n_partial - n_pre - n_hang} crashed)")
         out.append(
             f"- **Partial rows:** {n_partial} arm(s) died before their "
             "final result marker; their rows come from heartbeat salvage "

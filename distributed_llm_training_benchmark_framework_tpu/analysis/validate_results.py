@@ -245,6 +245,27 @@ def validate_result(r: dict, name: str) -> List[str]:
             "resumed=false — restart accounting is incoherent"
         )
 
+    # Sentinel-rollback coherence (self-healing round, docs/
+    # FAULT_TOLERANCE.md): a healed row's ledger must hang together —
+    # every rollback replays at least the step its trip poisoned (the
+    # checkpoint-save guard makes restore_step < trip_step structural),
+    # and replayed steps without a rollback mean the accounting broke.
+    n_rb = int(r.get("n_rollbacks") or 0)
+    n_replayed = int(r.get("rollback_steps_replayed") or 0)
+    if n_rb > 0:
+        _check(
+            n_replayed >= n_rb, name,
+            f"n_rollbacks={n_rb} but rollback_steps_replayed={n_replayed} "
+            "— every rollback replays at least one step; the sentinel "
+            "ledger is incoherent", f,
+        )
+    elif n_replayed > 0:
+        f.append(
+            f"{name}: rollback_steps_replayed={n_replayed} on a row with "
+            "n_rollbacks=0 — replayed steps without a rollback; the "
+            "sentinel ledger is incoherent"
+        )
+
     # Elastic-resume coherence: a geometry-changed stitch IS a resume —
     # the flag without resumed=true means the accounting (and therefore
     # the never-baseline exclusion downstream) is broken.

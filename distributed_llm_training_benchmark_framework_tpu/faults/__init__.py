@@ -7,7 +7,8 @@ partial) row, and nothing ever *proves* the recovery path works. This
 package is the proving ground:
 
 - :mod:`.injection` — a registry of injectable faults (``sigkill@N``,
-  ``sigterm@N``, ``nan-loss@N``, ``hang@N``, ``torn-checkpoint``,
+  ``sigterm@N``, ``nan-loss@N``, ``hang@N``, ``stall-rank@N:R``,
+  ``bitflip@N``, ``grad-explode@N``, ``torn-checkpoint``,
   ``enospc-on-save``), armed via the harness ``--inject-fault`` flag or
   the ``INJECT_FAULT`` env var, each firing at an exact sync-window
   boundary so a chaos run aborts at the same step every time.
@@ -15,6 +16,16 @@ package is the proving ground:
   train loop polls at sync boundaries, the :class:`Preempted` control
   exception, and the distinct ``EXIT_PREEMPTED`` process exit code the
   retrying orchestration keys on.
+- :mod:`.watchdog` — the hang watchdog (self-healing round): a monotonic
+  deadline on the sync-window cadence that dumps all-thread stacks into a
+  ``hang_dump`` telemetry event, coordinates a coherent all-host abort
+  over the coordination-service KV store, and exits ``EXIT_HUNG`` (76,
+  retryable-with-resume).
+- :mod:`.sentinel` — the numerics sentinel: boundary-cadence guards
+  (loss envelope, global grad-norm, per-N-steps parameter checksum) that
+  on trip roll the run back IN PROCESS to the last validated checkpoint,
+  reseed the data stream and replay, with ``n_rollbacks``/
+  ``rollback_steps_replayed`` accounting end to end.
 
 ``scripts/chaos_suite.sh`` drives the full fault matrix end to end and
 asserts every class lands in a completed, validated result (after
@@ -35,6 +46,15 @@ from .preemption import (  # noqa: F401
     Preempted,
     PreemptionGuard,
 )
+from .sentinel import (  # noqa: F401
+    NumericsSentinel,
+    SentinelTripped,
+)
+from .watchdog import (  # noqa: F401
+    EXIT_HUNG,
+    HangWatchdog,
+    Hung,
+)
 
 __all__ = [
     "FAULT_KINDS",
@@ -43,7 +63,12 @@ __all__ = [
     "parse_fault_spec",
     "EXIT_NOTHING_TO_RESUME",
     "EXIT_PREEMPTED",
+    "EXIT_HUNG",
+    "HangWatchdog",
+    "Hung",
     "NothingToResume",
+    "NumericsSentinel",
     "Preempted",
     "PreemptionGuard",
+    "SentinelTripped",
 ]

@@ -2,13 +2,18 @@
 
 Every fault fires at an exact, *reproducible* point in the run:
 
-- the stepped kinds (``sigkill@N``, ``sigterm@N``, ``hang@N``) fire at
-  the first sync-window boundary whose last completed step is >= N — the
-  loop is already fenced there, so the abort step in the telemetry trail
-  is the same on every run of the same spec;
+- the stepped kinds (``sigkill@N``, ``sigterm@N``, ``hang@N``,
+  ``stall-rank@N:R``) fire at the first sync-window boundary whose last
+  completed step is >= N — the loop is already fenced there, so the
+  abort step in the telemetry trail is the same on every run of the same
+  spec;
 - ``nan-loss@N`` corrupts exactly step N's loss at dispatch (the NaN
   surfaces at that step's sync window and trips the recorder's anomaly
   screen);
+- ``bitflip@N`` / ``grad-explode@N`` poison the parameter tree exactly
+  before step N dispatches (one huge element / one scaled leaf) — the
+  numerics-sentinel proof faults (``faults/sentinel.py``): the run must
+  detect, roll back to the last validated checkpoint and replay;
 - ``torn-checkpoint`` fires after the first checkpoint save that leaves
   a *previous* committed step behind it: it tears the newest step's
   payload (truncates one file) and SIGKILLs, so resume must quarantine
@@ -46,8 +51,22 @@ FAULT_KINDS = {
     "nan-loss": "corrupt step N's loss to NaN (trips the recorder's "
                 "anomaly screen; validate_results must reject the row)",
     "hang": "sleep at the first sync boundary with step >= N "
-            "(hang@N:SECS overrides the default stall; exercises "
-            "timeouts / the liveness probe)",
+            "(hang@N:SECS overrides the default stall; exercises the "
+            "in-process hang watchdog / the liveness probe)",
+    "stall-rank": "stall-rank@N:R[:SECS] — sleep at the first sync "
+                  "boundary with step >= N, but ONLY on rank R "
+                  "(exercises the cross-host hang broadcast: every OTHER "
+                  "rank must learn of the stall from the "
+                  "coordination-service hang flag and join the coherent "
+                  "EXIT_HUNG abort)",
+    "bitflip": "bitflip@N — corrupt one element of one parameter leaf "
+               "before step N dispatches (silent-data-corruption "
+               "analogue; the numerics sentinel's checksum/grad guards "
+               "must trip and roll back)",
+    "grad-explode": "grad-explode@N — scale one parameter leaf by a large "
+                    "factor before step N dispatches, so the step's "
+                    "global grad-norm explodes (the sentinel's grad-norm "
+                    "guard must trip and roll back)",
     "torn-checkpoint": "tear the newest checkpoint after a save that has "
                        "a previous committed step, then SIGKILL (restore "
                        "must quarantine and fall back)",
@@ -57,8 +76,22 @@ FAULT_KINDS = {
 
 #: Kinds that take a mandatory ``@N`` step.
 STEPPED_KINDS = frozenset(
-    {"sigkill", "sigterm", "sigterm-rank", "nan-loss", "hang"}
+    {"sigkill", "sigterm", "sigterm-rank", "nan-loss", "hang",
+     "stall-rank", "bitflip", "grad-explode"}
 )
+
+#: Kinds whose ``@N:R`` suffix names a target rank.
+RANKED_KINDS = frozenset({"sigterm-rank", "stall-rank"})
+
+#: The bitflip magnitude: large enough that a squared-norm reduction in
+#: f32 overflows to inf (1e30^2 > f32 max), so the sentinel's checksum /
+#: grad-norm guards trip deterministically on the very next boundary.
+BITFLIP_VALUE = 1e30
+#: grad-explode scales one leaf by this factor — logits saturate, the
+#: loss and the global grad-norm jump orders of magnitude, but nothing
+#: goes non-finite (the *envelope* guards must catch it, not a NaN
+#: screen).
+GRAD_EXPLODE_SCALE = 1e3
 
 #: Default stall for ``hang`` when the spec carries no ``:SECS``. Long
 #: enough that any sane per-run timeout (or the k8s liveness probe) fires
@@ -82,10 +115,11 @@ class FaultSpec:
         s = self.kind
         if self.step is not None:
             s += f"@{self.step}"
+        if self.rank is not None:
+            # Ranked grammar: KIND@N:R[:SECS] — the rank rides first.
+            s += f":{self.rank}"
         if self.hang_sec is not None:
             s += f":{self.hang_sec:g}"
-        if self.rank is not None:
-            s += f":{self.rank}"
         return s
 
 
@@ -93,10 +127,11 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
     """``"sigkill@10"`` -> FaultSpec; None/empty -> None; junk raises.
 
     Grammar: ``KIND`` | ``KIND@STEP`` | ``hang@STEP:SECS`` |
-    ``sigterm-rank@STEP:RANK``. Stepped kinds *require* the step (a fault
-    with no defined firing point would not be reproducible) —
-    ``sigterm-rank`` additionally requires the target rank; the save-path
-    kinds refuse one (they fire on save events, not steps).
+    ``sigterm-rank@STEP:RANK`` | ``stall-rank@STEP:RANK[:SECS]``.
+    Stepped kinds *require* the step (a fault with no defined firing
+    point would not be reproducible) — the ranked kinds additionally
+    require the target rank; the save-path kinds refuse one (they fire on
+    save events, not steps).
     """
     if not spec:
         return None
@@ -113,16 +148,17 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
                 f"fault {kind!r} needs an explicit step: {kind}@N "
                 "(a fault without a firing step is not reproducible)"
             )
-        step_str, _, secs_str = rest.partition(":")
-        if secs_str and kind not in ("hang", "sigterm-rank"):
+        step_str, _, suffix = rest.partition(":")
+        if suffix and kind not in ("hang", *RANKED_KINDS):
             raise ValueError(
-                f"only 'hang' and 'sigterm-rank' take a suffix, got {spec!r}"
+                f"only 'hang' and the ranked kinds "
+                f"({sorted(RANKED_KINDS)}) take a suffix, got {spec!r}"
             )
-        if kind == "sigterm-rank" and not secs_str:
+        if kind in RANKED_KINDS and not suffix:
             raise ValueError(
-                "sigterm-rank needs a target rank: sigterm-rank@N:R "
-                "(without one the fault is 'sigterm' — which rank dies is "
-                "the whole point of the spec)"
+                f"{kind} needs a target rank: {kind}@N:R (without one the "
+                f"fault is rankless — which rank it hits is the whole "
+                "point of the spec)"
             )
         try:
             step = int(step_str)
@@ -132,18 +168,34 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
             raise ValueError(f"fault step must be >= 0, got {spec!r}")
         hang_sec = None
         rank = None
-        if secs_str and kind == "sigterm-rank":
+        if suffix and kind in RANKED_KINDS:
+            rank_str, _, secs_str = suffix.partition(":")
+            if secs_str and kind != "stall-rank":
+                raise ValueError(
+                    f"only stall-rank takes a duration suffix, got {spec!r}"
+                )
             try:
-                rank = int(secs_str)
+                rank = int(rank_str)
             except ValueError:
                 raise ValueError(
-                    f"sigterm-rank target must be an integer rank, got {spec!r}"
+                    f"{kind} target must be an integer rank, got {spec!r}"
                 )
             if rank < 0:
                 raise ValueError(f"fault rank must be >= 0, got {spec!r}")
-        elif secs_str:
+            if secs_str:
+                try:
+                    hang_sec = float(secs_str)
+                except ValueError:
+                    raise ValueError(
+                        f"stall duration must be a number, got {spec!r}"
+                    )
+                if hang_sec <= 0:
+                    raise ValueError(
+                        f"stall duration must be > 0, got {spec!r}"
+                    )
+        elif suffix:
             try:
-                hang_sec = float(secs_str)
+                hang_sec = float(suffix)
             except ValueError:
                 raise ValueError(
                     f"hang duration must be a number, got {spec!r}"
@@ -226,14 +278,21 @@ class FaultInjector:
     # -- boundary faults ---------------------------------------------------
 
     def at_boundary(self, last_step: int) -> None:
-        """Fire sigkill/sigterm/hang at the first boundary past the step."""
+        """Fire sigkill/sigterm/hang/stall at the first boundary past N."""
         if (
             self.spec is None or self.fired
             or self.spec.kind not in (
-                "sigkill", "sigterm", "sigterm-rank", "hang"
+                "sigkill", "sigterm", "sigterm-rank", "hang", "stall-rank"
             )
             or last_step < (self.spec.step or 0)
         ):
+            return
+        if self.spec.kind == "stall-rank" and self.rank != (self.spec.rank or 0):
+            # Not this worker's stall: THIS rank must learn of the hang
+            # from the coordination-service broadcast (the watchdog's
+            # hang flag), not from its own stopped clock — that asymmetry
+            # is what the spec exists to prove. Stay armed (fired False):
+            # a healthy rank never fires anything.
             return
         self.fired = True
         if self.spec.kind == "sigkill":
@@ -253,6 +312,13 @@ class FaultInjector:
                 f"SIGTERM (rank {self.rank}) at sync boundary, step {last_step}"
             )
             os.kill(os.getpid(), signal.SIGTERM)
+        elif self.spec.kind == "stall-rank":
+            secs = self.spec.hang_sec or HANG_DEFAULT_SEC
+            self._announce(
+                f"stall (rank {self.rank}, {secs:g}s) at sync boundary, "
+                f"step {last_step}"
+            )
+            time.sleep(secs)
         else:  # hang
             secs = self.spec.hang_sec or HANG_DEFAULT_SEC
             self._announce(
@@ -274,6 +340,65 @@ class FaultInjector:
         # Multiplying keeps shape/dtype/sharding; no host sync, no
         # device fence — the NaN just rides the normal loss handle.
         return loss * float("nan")
+
+    # -- parameter corruption (numerics-sentinel proofs) -------------------
+
+    def corrupt_params(self, step: int, params):
+        """Poison the parameter tree before step N dispatches (else
+        passthrough) — the SDC / gradient-explosion injection point.
+
+        ``bitflip@N`` sets one element of one leaf (the LARGEST leaf —
+        deterministically the embedding table, whose poison flows into
+        every logit rather than being washed out by the next LayerNorm;
+        ties break on path) to :data:`BITFLIP_VALUE`; ``grad-explode@N``
+        scales that whole leaf by :data:`GRAD_EXPLODE_SCALE`. Pure device
+        ops on the fenced pre-dispatch handle: no host sync, no
+        shape/dtype/sharding change — the poison just rides the normal
+        params into the step, exactly like a real corrupted HBM word
+        would.
+        """
+        if (
+            self.spec is None or self.fired
+            or self.spec.kind not in ("bitflip", "grad-explode")
+            or step != self.spec.step
+        ):
+            return params
+        self.fired = True
+        import jax
+        import jax.numpy as jnp
+
+        leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+        if self.spec.kind == "grad-explode":
+            # Prefer the embedding table (weight-tied LM head): scaling it
+            # multiplies every logit, so the loss and the backward pass
+            # explode THROUGH the normalization layers instead of being
+            # washed out by the next LayerNorm. Fall back to the largest
+            # leaf on head-less trees.
+            named = [e for e in leaves if "wte" in str(e[0])]
+            leaves = named or leaves
+        victim_path, victim = sorted(
+            leaves, key=lambda e: (-getattr(e[1], "size", 0), str(e[0]))
+        )[0]
+        name = jax.tree_util.keystr(victim_path)
+        if self.spec.kind == "bitflip":
+            poisoned = victim.at[(0,) * victim.ndim].set(
+                jnp.asarray(BITFLIP_VALUE, victim.dtype)
+            )
+            self._announce(
+                f"bitflip: params{name}[0...] = {BITFLIP_VALUE:g} before "
+                f"step {step}"
+            )
+        else:
+            poisoned = victim * jnp.asarray(GRAD_EXPLODE_SCALE, victim.dtype)
+            self._announce(
+                f"grad-explode: params{name} scaled x{GRAD_EXPLODE_SCALE:g} "
+                f"before step {step}"
+            )
+
+        def swap(path, leaf):
+            return poisoned if path == victim_path else leaf
+
+        return jax.tree_util.tree_map_with_path(swap, params)
 
     # -- save-path faults --------------------------------------------------
 

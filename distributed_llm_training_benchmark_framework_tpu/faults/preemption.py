@@ -34,8 +34,11 @@ EXIT_PREEMPTED = 75
 #: left to run (the run already completed, or the checkpoint belongs to a
 #: longer configuration). DETERMINISTIC: the retry wrappers must NOT
 #: retry it — every attempt would refuse identically and the backoff
-#: budget would burn on nothing.
-EXIT_NOTHING_TO_RESUME = 76
+#: budget would burn on nothing. (Renumbered 76 -> 77 in the self-healing
+#: round: 76 is now EXIT_HUNG — the hang watchdog's retryable-with-resume
+#: abort, the semantic opposite of this never-retry refusal, so the two
+#: could not share a code; faults/watchdog.py.)
+EXIT_NOTHING_TO_RESUME = 77
 
 
 class NothingToResume(RuntimeError):

@@ -1,0 +1,342 @@
+"""Hang watchdog: a monotonic deadline on the sync-window cadence.
+
+A hung collective (one stalled rank, a wedged DMA, a deadlocked host
+thread) is the one failure class the chaos stack could not yet classify
+*in process*: the run simply stops emitting sync-window events and some
+external supervisor — the k8s liveness probe, a suite timeout — kills it
+minutes later with a generic 124/137 and no forensics. The watchdog turns
+that into a first-class, classified abort:
+
+- the loop **beats** the watchdog at every sync-window boundary (an
+  attribute write — no IO, no device work; the same call-site discipline
+  as :class:`~.preemption.PreemptionGuard`'s boundary poll, GC105/GC106
+  clean by construction);
+- a daemon thread checks the deadline. When no boundary arrives within
+  ``timeout_sec`` it dumps **all-thread stacks** plus the last beat into a
+  ``hang_dump`` telemetry event (the JSONL is line-buffered, so the dump
+  survives the process), prints the same dump to stderr, emits
+  ``run_aborted reason=hang`` + a final ``reason=hang`` heartbeat, and
+  exits with the distinct :data:`EXIT_HUNG` code the retrying
+  orchestration treats as retryable-with-resume;
+- on a ``jax.distributed`` rendezvous the firing rank first publishes a
+  hang flag on the coordination-service KV store
+  (``runtime.distributed.publish_hang_flag``). Peers see it — the watchdog
+  thread polls the flag namespace, and ranks still reaching boundaries
+  poll it there too — and abort with the *same* exit code and a dump of
+  their own stacks, so one stuck rank yields a coherent all-host abort
+  instead of N staggered timeouts.
+
+Scope: the watchdog guards the *step loop's* sync-window cadence. It arms
+at the first beat (init/XLA compile legitimately run many minutes with no
+boundaries — the same posture scripts/liveness_probe.sh takes before the
+first telemetry event) and is disarmed before the finalize tail. Hangs
+outside that bracket stay the liveness probe's job; the probe's grace
+window must therefore EXCEED ``timeout_sec`` so the in-process dump wins
+the race against the probe's forensics-free pod kill
+(docs/FAULT_TOLERANCE.md).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+#: Process exit code for a run aborted by the hang watchdog. Distinct from
+#: crash codes (1, 134, 137, 139), timeout(1)'s 124 and EXIT_PREEMPTED
+#: (75); the retry wrappers treat it as retryable-with-resume — the
+#: checkpoints on disk are intact, only the process wedged.
+EXIT_HUNG = 76
+
+
+class Hung(RuntimeError):
+    """Control-flow exception: a PEER rank's hang flag was seen at a
+    boundary (this rank is healthy — the stuck one already dumped and
+    exited). The harness maps it to :data:`EXIT_HUNG` so the abort is
+    unanimous across ranks."""
+
+    def __init__(self, step: int, peer: Optional[int] = None):
+        self.step = step
+        self.peer = peer
+        who = f"rank {peer}" if peer is not None else "a peer rank"
+        super().__init__(
+            f"aborting at boundary step {step}: {who} reported a hang"
+        )
+
+
+def _scan_peer_flags() -> Optional[Tuple[int, int]]:
+    """(rank, step) of another rank's published hang flag, or None.
+
+    The ONE peer-flag scan behind both halves of the coherent abort —
+    the loop's fenced boundary poll and the watchdog thread — so the
+    process-count guard and own-rank filtering can never diverge.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return None
+    from ..runtime import distributed as dist
+
+    for rank, step in dist.hang_flag_entries():
+        if rank != jax.process_index():
+            return rank, step
+    return None
+
+
+def format_all_stacks() -> List[str]:
+    """One formatted stack per live thread — the hang_dump payload.
+
+    ``sys._current_frames`` is a snapshot, not a stop-the-world: good
+    enough for "where was everyone when the deadline passed", which is
+    the question a hung collective leaves unanswered.
+    """
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: List[str] = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        name = names.get(ident, "?")
+        stack = "".join(traceback.format_stack(frame))
+        out.append(f"Thread {name} (ident {ident}):\n{stack}")
+    return out
+
+
+class HangWatchdog:
+    """Deadline timer over the loop's sync-window beats.
+
+    Parameters
+    ----------
+    timeout_sec:
+        Max seconds between beats before the run is declared hung.
+        ``<= 0`` disables the watchdog entirely (``armed`` False) — the
+        default, so benchmark runs pay one attribute check per boundary
+        and nothing else.
+    recorder:
+        The run's flight recorder (telemetry.TelemetryRecorder) — the
+        ``hang_dump`` event, the ``run_aborted reason=hang`` trail and
+        the final heartbeat go through it. Optional for direct users.
+    """
+
+    def __init__(
+        self,
+        timeout_sec: float = 0.0,
+        *,
+        recorder=None,
+        is_main: bool = True,
+        rank: int = 0,
+        poll_interval_sec: Optional[float] = None,
+        _exit: Callable[[int], Any] = os._exit,
+    ):
+        self.timeout_sec = float(timeout_sec or 0.0)
+        self.recorder = recorder
+        self.is_main = is_main
+        self.rank = rank
+        self._exit = _exit
+        self.poll_interval_sec = (
+            poll_interval_sec
+            if poll_interval_sec is not None
+            else max(min(self.timeout_sec / 4.0, 5.0), 0.05)
+        )
+        self._last_beat: Optional[float] = None  # monotonic; None = unarmed
+        self._last_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    @property
+    def armed(self) -> bool:
+        return self.timeout_sec > 0
+
+    # -- loop-facing surface (boundary call sites only) --------------------
+
+    def beat(self, step: int) -> None:
+        """Feed the deadline: a sync-window boundary arrived. Attribute
+        writes only — safe at any cadence, sanctioned at boundaries."""
+        self._last_beat = time.monotonic()
+        self._last_step = step
+
+    def peer_hang(self) -> Optional[Tuple[int, int]]:
+        """Non-blocking boundary poll: (rank, step) of a peer's published
+        hang flag, or None. The *healthy*-rank half of the coherent
+        all-host abort — a rank still reaching boundaries (process-local
+        dryrun meshes, or a stall that only wedges some ranks) learns of
+        the hang here and raises :class:`Hung` from its own main thread
+        instead of waiting out its own timeout. Unlike the thread-side
+        :meth:`_poll_peer_flag` this lets errors PROPAGATE — the main
+        thread's caller owns the failure."""
+        if not self.armed:
+            return None
+        return _scan_peer_flags()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if not self.armed or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="hang-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def disarm(self) -> None:
+        """Stop the deadline thread (idempotent). Called before the
+        finalize tail — post-loop work (final checkpoint, barrier, AOT
+        memory accounting) has no sync-window cadence to guard."""
+        self._stop.set()
+        thread = self._thread
+        self._thread = None
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=2.0)
+
+    # -- the deadline thread ----------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_sec):
+            last = self._last_beat
+            if last is None:
+                # Not yet armed: init/compile may legitimately take longer
+                # than any sane hang timeout (liveness-probe posture).
+                continue
+            stalled = time.monotonic() - last
+            if stalled > self.timeout_sec:
+                self._fire(
+                    reason=(
+                        f"no sync-window boundary for {stalled:.1f}s "
+                        f"(> --hang-timeout-sec {self.timeout_sec:g}; last "
+                        f"boundary step {self._last_step})"
+                    ),
+                )
+                return
+            peer = self._poll_peer_flag()
+            if peer is not None:
+                self._fire(
+                    reason=(
+                        f"peer rank {peer[0]} reported a hang at its "
+                        f"boundary step {peer[1]} (this rank last beat "
+                        f"{stalled:.1f}s ago)"
+                    ),
+                    peer=peer[0],
+                )
+                return
+
+    def _poll_peer_flag(self) -> Optional[Tuple[int, int]]:
+        """Thread-side peer poll, best-effort: a rank blocked inside a
+        collective never reaches another boundary, so its MAIN thread
+        cannot learn of the peer's flag — this thread can. Errors degrade
+        to the local timeout (which is also ticking)."""
+        try:
+            return _scan_peer_flags()
+        except Exception:
+            return None
+
+    def _fire(self, reason: str, peer: Optional[int] = None) -> None:
+        """Dump, publish, record, exit 76. Runs on the watchdog thread —
+        the main thread is by definition stuck, so nothing here may wait
+        on it; ``os._exit`` skips interpreter teardown deliberately (the
+        telemetry file is line-buffered, every event already reached the
+        OS)."""
+        if self.fired:
+            return
+        self.fired = True
+        stacks = format_all_stacks()
+        # Publish FIRST (cheap host RPC): even if the dump below wedges on
+        # a broken recorder, the peers must learn of the hang.
+        if peer is None:
+            try:
+                from ..runtime import distributed as dist
+
+                dist.publish_hang_flag(self._last_step or 0)
+            except Exception:
+                pass
+        header = (
+            f"HANG WATCHDOG (rank {self.rank}): {reason} — dumping "
+            f"{len(stacks)} thread stack(s) and exiting {EXIT_HUNG}"
+        )
+        try:
+            print(header, file=sys.stderr, flush=True)
+            for s in stacks:
+                print(s, file=sys.stderr, flush=True)
+        except Exception:
+            pass
+        if self.recorder is not None:
+            try:
+                self.recorder.note(
+                    "hang_dump",
+                    reason=reason,
+                    last_beat_step=self._last_step,
+                    timeout_sec=self.timeout_sec,
+                    peer_rank=peer,
+                    stacks=stacks,
+                )
+                self.recorder.emergency_heartbeat(
+                    reason="hang",
+                    extra={"last_beat_step": self._last_step},
+                )
+                self.recorder.abort("hang")
+            except Exception:
+                pass
+        _linger_for_coherent_exit(self.poll_interval_sec)
+        self._exit(EXIT_HUNG)
+
+    # -- context sugar -----------------------------------------------------
+
+    def __enter__(self) -> "HangWatchdog":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+def _linger_for_coherent_exit(poll_interval_sec: float) -> None:
+    """Multi-host abort ordering: every aborting rank LINGERS before
+    dying, and the coordination-service HOST (process 0) lingers longest
+    so it provably exits LAST. Two failure modes this prevents, both
+    observed on the dryrun: (a) the origin exiting before its peers
+    polled the hang flag — they then die of a coordination heartbeat
+    timeout's uncatchable FATAL (crash code, no classification); (b) a
+    healthy peer on process 0 exiting FIRST after seeing the flag —
+    tearing the KV store down under the still-lingering origin. The
+    stack dump is already on disk before any linger, so the wait risks
+    nothing. Single-process runs skip it entirely."""
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            linger = min(max(2 * poll_interval_sec, 2.0), 10.0)
+            if jax.process_index() == 0:
+                linger += 2.0
+            time.sleep(linger)
+    except Exception:
+        pass
+
+
+def abort_on_peer_hang(recorder, step: int, peer: Tuple[int, int]) -> None:
+    """Main-thread half of the coherent abort: emit the (stackless) dump
+    trail for a peer-reported hang and raise :class:`Hung`. Shared by the
+    loop's boundary poll so the telemetry shape matches the thread path —
+    collect/parse classify both as ``reason=hang``."""
+    rank, peer_step = peer
+    if recorder is not None:
+        try:
+            recorder.note(
+                "hang_dump",
+                reason=(f"peer rank {rank} reported a hang at its boundary "
+                        f"step {peer_step}; this rank is healthy at "
+                        f"boundary {step}"),
+                last_beat_step=step,
+                peer_rank=rank,
+                stacks=format_all_stacks(),
+            )
+            recorder.emergency_heartbeat(
+                reason="hang", extra={"last_beat_step": step},
+            )
+            recorder.abort("hang")
+        except Exception:
+            pass
+    # Same exit-ordering discipline as the thread path: this rank's
+    # unwind tears down its jax.distributed client, and on process 0
+    # that is the coordination service itself.
+    _linger_for_coherent_exit(1.0)
+    raise Hung(step, peer=rank)

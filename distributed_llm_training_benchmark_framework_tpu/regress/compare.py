@@ -6,6 +6,7 @@
     ... regress compare <id-or-sel> <id-or-sel> [--arm ARM]
     ... regress trend <arm> [--png trend.png] [--limit N]
     ... regress gate --baseline last-good --candidate latest [--arm ARM|--all]
+    ... regress bisect <good> <bad> [--arm ARM]   # first-bad git-sha boundary
 
 Exit codes mirror graftcheck (the other standing gate): 0 clean, 1 a
 significant regression (gate) or a failed comparison the caller asked to
@@ -166,6 +167,12 @@ def gate_arm(
                 f"regress gate: SKIP arm={arm} candidate "
                 f"{cand.get('record_id')} is a resumed (stitched) run — "
                 "not a clean measurement; rerun the arm for a verdict")
+    if (cand.get("result") or {}).get("n_rollbacks"):
+        return (stats.VERDICT_INSUFFICIENT,
+                f"regress gate: SKIP arm={arm} candidate "
+                f"{cand.get('record_id')} is a rolled-back (sentinel-"
+                "healed) run — it hit a numerics incident and replayed "
+                "steps; rerun the arm for a verdict")
     if baseline_sel == "last-good":
         base = reg.baseline(
             arm, exclude_record_id=cand.get("record_id"),
@@ -232,6 +239,120 @@ def verdict_line_for_bench(
 
 
 # ---------------------------------------------------------------------------
+# Bisect (benchreg follow-up (b))
+# ---------------------------------------------------------------------------
+
+
+def bisect_records(
+    reg: store.Registry, good: Dict[str, Any], bad: Dict[str, Any],
+) -> Dict[str, Any]:
+    """Walk the registry between a known-good and a known-bad record and
+    find the first-bad boundary, keyed by the env-fingerprint git shas.
+
+    Both records must belong to one arm and sit in ``good`` -> ``bad``
+    ingest order (the registry's ``seq`` clock). The threshold is the
+    midpoint of the two endpoints' metric values (direction from the
+    metric's ``higher_is_better``): each intermediate ok record is
+    classified good/bad against it, and the first bad one — together
+    with the last good one before it — names the git-sha boundary to
+    diff. Records without the metric (partials) are listed but never
+    classify.
+    """
+    arm = good.get("arm")
+    if arm != bad.get("arm"):
+        raise KeyError(
+            f"bisect needs two records of one arm, got {arm!r} and "
+            f"{bad.get('arm')!r}"
+        )
+
+    def _val(rec):
+        m = rec.get("metric") or {}
+        return m.get("value")
+
+    g_val, b_val = _val(good), _val(bad)
+    if g_val is None or b_val is None:
+        raise KeyError("bisect endpoints must both carry a metric value")
+    higher_better = bool(
+        (good.get("metric") or {}).get("higher_is_better", True)
+    )
+    threshold = (float(g_val) + float(b_val)) / 2.0
+
+    recs = reg.records(arm)
+    ids = [r.get("record_id") for r in recs]
+    try:
+        i_good, i_bad = ids.index(good.get("record_id")), ids.index(
+            bad.get("record_id")
+        )
+    except ValueError:
+        raise KeyError("bisect endpoints must both be ingested records "
+                       f"of arm {arm!r}")
+    if i_good >= i_bad:
+        raise KeyError(
+            "bisect walks ingest order: the good record must precede the "
+            f"bad one (got seq {i_good} -> {i_bad})"
+        )
+
+    rows: List[Dict[str, Any]] = []
+    last_good = good
+    first_bad: Optional[Dict[str, Any]] = None
+    for rec in recs[i_good: i_bad + 1]:
+        val = _val(rec)
+        verdict = None
+        if rec.get("status") == "ok" and val is not None:
+            is_bad = (val < threshold) if higher_better else (val > threshold)
+            verdict = "bad" if is_bad else "good"
+        rows.append({
+            "record_id": rec.get("record_id"),
+            "git_sha": (rec.get("env") or {}).get("git_sha"),
+            "value": val,
+            "status": rec.get("status"),
+            "verdict": verdict,
+        })
+        if verdict == "good" and first_bad is None:
+            last_good = rec
+        elif verdict == "bad" and first_bad is None:
+            first_bad = rec
+    return {
+        "arm": arm,
+        "metric": (good.get("metric") or {}).get("name"),
+        "threshold": threshold,
+        "rows": rows,
+        "last_good": last_good,
+        "first_bad": first_bad,
+    }
+
+
+def format_bisect(rep: Dict[str, Any]) -> str:
+    lines = [
+        f"== regress bisect: {rep['arm']} ({rep['metric']}, threshold "
+        f"{rep['threshold']:,.2f}) ==",
+    ]
+    for r in rep["rows"]:
+        val = f"{r['value']:,.2f}" if r["value"] is not None else "-"
+        lines.append(
+            f"  {r['record_id']}  sha={r['git_sha'] or '?':<10} "
+            f"{val:>14}  {r['verdict'] or r['status']}"
+        )
+    fb = rep["first_bad"]
+    lg = rep["last_good"]
+    if fb is None:
+        lines.append(
+            "  no intermediate record classifies as bad — the regression "
+            "is not reproduced between these endpoints (missing history, "
+            "or a noise-level delta)"
+        )
+    else:
+        lines.append(
+            f"  FIRST BAD: {fb.get('record_id')} at git sha "
+            f"{(fb.get('env') or {}).get('git_sha') or '?'} "
+            f"(last good {lg.get('record_id')} at "
+            f"{(lg.get('env') or {}).get('git_sha') or '?'}) — diff those "
+            "shas"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
 # Trend
 # ---------------------------------------------------------------------------
 
@@ -273,6 +394,9 @@ def trend_rows(
                      and best is not None and val == best),
             "banked": rec.get("record_id") in banked,
             "resumed": bool((rec.get("result") or {}).get("resumed")),
+            "rolled_back": bool(
+                (rec.get("result") or {}).get("n_rollbacks")
+            ),
         })
         if rec.get("status") == "ok" and val is not None:
             prev_ok = val
@@ -288,6 +412,7 @@ def format_trend(arm: str, rows: List[Dict[str, Any]]) -> str:
         flags = ("PARTIAL" if r["status"] != "ok"
                  else "BANKED" if r.get("banked")
                  else "RESUMED" if r.get("resumed")
+                 else "HEALED" if r.get("rolled_back")
                  else ("BEST" if r["best"] else ""))
         out.append(
             f"  {r['record_id']}  {val:>14} {r['metric_name'] or '':<24}"
@@ -381,6 +506,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     default=stats.DEFAULT_MIN_EFFECT_PCT)
     pg.add_argument("--alpha", type=float, default=stats.DEFAULT_ALPHA)
 
+    pbi = sub.add_parser(
+        "bisect",
+        help="walk env fingerprints (git shas) between a good and a bad "
+             "record and print the first-bad boundary",
+    )
+    pbi.add_argument("good", help="known-good: record-id prefix | last-good")
+    pbi.add_argument("bad", help="known-bad: record-id prefix | latest")
+    pbi.add_argument("--arm", default=None,
+                     help="required when a selector is latest/last-good")
+
     sub.add_parser("list", help="list arms and record counts")
 
     pb = sub.add_parser(
@@ -429,6 +564,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
             print(format_comparison(rep))
             return 1 if rep["verdict"] == stats.VERDICT_REGRESSION else 0
+
+        if args.cmd == "bisect":
+            good = resolve_selector(reg, args.good, args.arm)
+            bad = resolve_selector(reg, args.bad, args.arm)
+            rep = bisect_records(reg, good, bad)
+            print(format_bisect(rep))
+            return 0
 
         if args.cmd == "trend":
             rows = trend_rows(reg, args.arm, limit=args.limit)

@@ -122,10 +122,30 @@ def split_masked_windows(
     comparing it verdicts the incident. The masked windows are returned
     (not dropped on the floor) so every consumer can surface a
     ``masked_windows`` count beside its verdict.
+
+    Sentinel rollbacks (self-healing round) mask the same way: a
+    ``rollback`` event means every window in ``(to_step, from_step]`` was
+    measured twice — once poisoned, once replaying over the restore — and
+    neither copy is run-to-run jitter of the code under test. Both copies
+    leave the comparison sample, mirroring the result row's
+    replayed-steps exclusion.
     """
     from ..telemetry import spike_mask_intervals, step_in_spike
 
-    intervals = spike_mask_intervals(list(events)) if mask_spikes else []
+    events = list(events)
+    intervals = spike_mask_intervals(events) if mask_spikes else []
+    rollbacks = [
+        (e.get("to_step"), e.get("from_step"))
+        for e in events
+        if e.get("event") == "rollback"
+        and e.get("to_step") is not None and e.get("from_step") is not None
+    ]
+
+    def in_rollback(step):
+        return step is not None and any(
+            lo < step <= hi for lo, hi in rollbacks
+        )
+
     kept: List[Dict[str, Any]] = []
     masked: List[Dict[str, Any]] = []
     for e in events:
@@ -140,7 +160,10 @@ def split_masked_windows(
             "dt": float(dt),
             "loss": e.get("loss"),
         }
-        if intervals and step_in_spike(e.get("step"), intervals):
+        if (
+            (intervals and step_in_spike(e.get("step"), intervals))
+            or in_rollback(e.get("step"))
+        ):
             masked.append(w)
         else:
             kept.append(w)

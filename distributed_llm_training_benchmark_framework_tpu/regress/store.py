@@ -40,7 +40,10 @@ Properties the gate relies on:
   is an honest *record* but a dishonest *baseline*: its first timed
   window folds in the restore recompile and its step population spans
   two attempts, so ``baseline()``/``history_values()`` skip it the same
-  way they skip partials.
+  way they skip partials. Rolled-back runs (``result.n_rollbacks`` > 0 —
+  the numerics sentinel healed them in-process, self-healing round) are
+  excluded for the same reason: their replayed region ran twice and the
+  trip itself says the run hit a numerics incident.
 - **Known-regressed records are banked, not adopted.** When the gate
   verdicts a regression, the candidate's record_id is appended to
   ``banked.jsonl`` (append-only, bank/unbank action lines): "last known
@@ -529,7 +532,8 @@ class Registry:
         THE baseline-eligibility filter chain, shared by :meth:`baseline`,
         :meth:`history_values` and :meth:`result_history_values` so the
         primary and secondary noise floors can never disagree about which
-        runs count: status ok, unbanked, not resumed — the
+        runs count: status ok, unbanked, not resumed, not rolled-back
+        (sentinel-healed, ``n_rollbacks`` > 0) — the
         resume_geometry_changed check is defense in depth for a row whose
         accounting broke (flag without resumed; docs/FAULT_TOLERANCE.md)
         — not the candidate itself, and sharing the candidate's
@@ -544,6 +548,8 @@ class Registry:
                 continue
             res = rec.get("result") or {}
             if res.get("resumed") or res.get("resume_geometry_changed"):
+                continue
+            if res.get("n_rollbacks"):
                 continue
             if exclude_record_id and rec.get("record_id") == exclude_record_id:
                 continue

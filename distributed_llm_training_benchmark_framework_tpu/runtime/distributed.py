@@ -37,6 +37,13 @@ import jax
 _PREEMPT_FLAG_PREFIX = "benchpreempt/flag/"
 _PREEMPT_ACK_PREFIX = "benchpreempt/ack/"
 
+#: Namespace for the hang watchdog's "rank R wedged" broadcast
+#: (faults/watchdog.py, self-healing round). Same lifetime/channel
+#: properties as the preempt flags; no ack protocol — a hang is
+#: unrecoverable in process, so the only agreement needed is "abort with
+#: EXIT_HUNG", which every rank reaches from the flag alone.
+_HANG_FLAG_PREFIX = "benchhang/flag/"
+
 #: How long one host waits for every other host's preemption ack before
 #: degrading to a local-only decision. The acks arrive at the peers' next
 #: sync-window boundaries — milliseconds-to-seconds apart in a lockstep
@@ -168,28 +175,29 @@ def _coordination_client():
         return None
 
 
-def publish_preempt_flag(step: int) -> bool:
-    """Announce this host's SIGTERM to every other host (idempotent-ish:
-    callers publish once). Returns False when no channel exists."""
+def _publish_flag(prefix: str, step: int) -> bool:
+    """Publish ``<prefix><my rank> = <step>`` on the KV store; False when
+    no channel exists. The shared write half of both broadcast channels
+    (preempt-soon and hang) — one implementation, two namespaces."""
     client = _coordination_client()
     if client is None:
         return False
     try:
         client.key_value_set(
-            f"{_PREEMPT_FLAG_PREFIX}{jax.process_index()}", str(int(step))
+            f"{prefix}{jax.process_index()}", str(int(step))
         )
         return True
     except Exception:
         return False
 
 
-def preempt_flag_entries() -> List[Tuple[int, int]]:
-    """Non-blocking poll: [(rank, step), ...] of published preempt flags."""
+def _flag_entries(prefix: str) -> List[Tuple[int, int]]:
+    """Non-blocking poll of one flag namespace: [(rank, step), ...]."""
     client = _coordination_client()
     if client is None:
         return []
     try:
-        entries = client.key_value_dir_get(_PREEMPT_FLAG_PREFIX)
+        entries = client.key_value_dir_get(prefix)
     except Exception:
         return []
     out: List[Tuple[int, int]] = []
@@ -199,6 +207,28 @@ def preempt_flag_entries() -> List[Tuple[int, int]]:
         except (ValueError, IndexError):
             continue
     return out
+
+
+def publish_preempt_flag(step: int) -> bool:
+    """Announce this host's SIGTERM to every other host (idempotent-ish:
+    callers publish once). Returns False when no channel exists."""
+    return _publish_flag(_PREEMPT_FLAG_PREFIX, step)
+
+
+def preempt_flag_entries() -> List[Tuple[int, int]]:
+    """Non-blocking poll: [(rank, step), ...] of published preempt flags."""
+    return _flag_entries(_PREEMPT_FLAG_PREFIX)
+
+
+def publish_hang_flag(step: int) -> bool:
+    """Announce this host's hang-watchdog firing to every other host
+    (faults/watchdog.py). Returns False when no channel exists."""
+    return _publish_flag(_HANG_FLAG_PREFIX, step)
+
+
+def hang_flag_entries() -> List[Tuple[int, int]]:
+    """Non-blocking poll: [(rank, step), ...] of published hang flags."""
+    return _flag_entries(_HANG_FLAG_PREFIX)
 
 
 def agree_preempt_step(

@@ -215,10 +215,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--inject-fault", type=str, default=None,
                    help="Arm one deterministic chaos fault: sigkill@N, "
                         "sigterm@N, nan-loss@N, hang@N[:SECS], "
-                        "torn-checkpoint, enospc-on-save — each fires at "
-                        "an exact sync-window boundary so chaos runs are "
-                        "reproducible (scripts/chaos_suite.sh drives the "
-                        "matrix)")
+                        "stall-rank@N:R[:SECS], bitflip@N, "
+                        "grad-explode@N, torn-checkpoint, enospc-on-save "
+                        "— each fires at an exact sync-window boundary so "
+                        "chaos runs are reproducible "
+                        "(scripts/chaos_suite.sh drives the matrix)")
+    # Self-healing loop (faults/watchdog.py + faults/sentinel.py,
+    # docs/FAULT_TOLERANCE.md): in-process hang detection with a
+    # stack-dump abort, and numerics guards that roll back and replay
+    # instead of dying.
+    p.add_argument("--hang-timeout-sec", type=float, default=0.0,
+                   help="Arm the hang watchdog: when no sync-window "
+                        "boundary arrives for this many seconds, dump "
+                        "all-thread stacks into a hang_dump telemetry "
+                        "event, broadcast the hang to every rank, and "
+                        "exit the distinct retryable code 76 (EXIT_HUNG). "
+                        "0 = off. The k8s liveness probe's grace window "
+                        "must EXCEED this timeout so the in-process dump "
+                        "wins the race (scripts/liveness_probe.sh)")
+    p.add_argument("--sentinel", choices=["on", "off"], default="off",
+                   help="Numerics sentinel: screen each synced window's "
+                        "loss and in-step global grad-norm; on a trip, "
+                        "roll back in-process to the last validated "
+                        "checkpoint, reseed the data stream and replay "
+                        "(n_rollbacks accounting on the result row) "
+                        "instead of dying. Adds one fused grad-norm "
+                        "reduction to the step, so it is opt-in")
+    p.add_argument("--sentinel-checksum-every", type=int, default=0,
+                   help="With --sentinel on: every N steps, checksum the "
+                        "parameter tree (global L2 norm) at a fenced "
+                        "boundary to catch silent data corruption "
+                        "(bitflips) that no loss/grad screen sees. "
+                        "0 = checksum guard off")
     # Overlap round 2 (docs/PERFORMANCE.md): turn on XLA's latency-hiding
     # scheduler + async collective fusion (utils.platform
     # .LATENCY_HIDING_XLA_FLAGS) — the compiler half of the zero2
@@ -324,8 +352,10 @@ def main(argv=None) -> int:
         process_id=args.rank if args.num_processes else None,
     )
     from ..faults import (
+        EXIT_HUNG,
         EXIT_NOTHING_TO_RESUME,
         EXIT_PREEMPTED,
+        Hung,
         NothingToResume,
         Preempted,
     )
@@ -379,6 +409,9 @@ def main(argv=None) -> int:
             telemetry=args.telemetry == "on",
             heartbeat_sec=args.heartbeat_sec,
             inject_fault=args.inject_fault,
+            hang_timeout_sec=args.hang_timeout_sec,
+            sentinel=args.sentinel == "on",
+            sentinel_checksum_every=args.sentinel_checksum_every,
         )
     except Preempted as e:
         # Distinct exit code: the retrying orchestration (with_retries.sh,
@@ -392,6 +425,14 @@ def main(argv=None) -> int:
         print(f"NOTHING TO RESUME: {e} — exiting {EXIT_NOTHING_TO_RESUME}",
               flush=True)
         return EXIT_NOTHING_TO_RESUME
+    except Hung as e:
+        # A PEER rank's watchdog reported a hang (this rank is healthy —
+        # the stuck one already dumped its stacks and exited 76 from its
+        # own watchdog thread). Unanimous EXIT_HUNG: the retry wrappers
+        # treat it as retryable-with-resume on every rank.
+        print(f"HUNG: {e} — exiting {EXIT_HUNG} (retryable with --resume)",
+              flush=True)
+        return EXIT_HUNG
     finally:
         dist.cleanup_distributed()
     return 0

@@ -30,6 +30,22 @@ reason=preempted`` plus a final heartbeat, and exits with the distinct
 ``EXIT_PREEMPTED`` code the retrying orchestration resumes on. The same
 boundaries host the deterministic fault injector (``--inject-fault`` /
 ``INJECT_FAULT``) the chaos suite uses to prove all of this works.
+
+Self-healing round (docs/FAULT_TOLERANCE.md): two more boundary-cadence
+guards ride the same discipline. The **hang watchdog**
+(``faults.HangWatchdog``, ``--hang-timeout-sec``) is beaten at every
+sync-window boundary; when a boundary fails to arrive in time it dumps
+all-thread stacks into a ``hang_dump`` telemetry event, broadcasts a hang
+flag over the coordination-service KV store so every rank aborts
+coherently, and exits the distinct ``EXIT_HUNG`` (76,
+retryable-with-resume). The **numerics sentinel**
+(``faults.NumericsSentinel``, ``--sentinel on``) screens each synced
+window's loss + in-step global grad-norm (and a per-N-steps parameter
+checksum) and on trip does NOT kill the run: it rolls back in-process to
+the last validated checkpoint, reseeds the data stream past the poisoned
+region, and replays — with ``n_rollbacks``/``rollback_steps_replayed``
+accounting on the result row and replayed windows excluded from the
+timed distributions.
 """
 
 from __future__ import annotations
@@ -45,11 +61,15 @@ import numpy as np
 from ..data import SyntheticDataset
 from ..faults import (
     FaultInjector,
+    HangWatchdog,
     NothingToResume,
+    NumericsSentinel,
     Preempted,
     PreemptionGuard,
+    SentinelTripped,
     parse_fault_spec,
 )
+from ..faults.watchdog import abort_on_peer_hang
 from ..models import get_model_config
 from ..parallel import make_mesh, StrategyConfig
 from ..runtime import distributed as dist
@@ -57,6 +77,39 @@ from ..telemetry import TelemetryRecorder
 from ..utils import flops as flops_mod
 from ..utils import metrics as metrics_mod
 from .step import create_train_state
+
+
+class _StepCursor:
+    """The loop's step iterator, with in-run rollback support.
+
+    Yields ``start .. stop-1`` like the plain ``range`` it replaces, but
+    the numerics sentinel's rollback handler can rewind it
+    (:meth:`rollback`) so the loop replays from the restored checkpoint —
+    keeping the ``for step in ...`` shape the graftcheck timed-loop rules
+    (GC102/GC105/GC106) police. ``replay_until`` marks the highest step
+    already measured once: replayed steps at or below it are excluded
+    from the timed step-time distribution (their windows fold the
+    restore; the original, poisoned measurements were truncated).
+    """
+
+    def __init__(self, start: int, stop: int):
+        self.next_step = start
+        self.stop = stop
+        self.replay_until = -1
+
+    def __iter__(self) -> "_StepCursor":
+        return self
+
+    def __next__(self) -> int:
+        if self.next_step >= self.stop:
+            raise StopIteration
+        s = self.next_step
+        self.next_step = s + 1
+        return s
+
+    def rollback(self, to_step: int, tripped_at: int) -> None:
+        self.next_step = to_step + 1
+        self.replay_until = max(self.replay_until, tripped_at)
 
 
 def _make_recorder(kwargs: dict) -> TelemetryRecorder:
@@ -158,10 +211,22 @@ def run_benchmark(*, prng_impl: str = "rbg", **kwargs) -> metrics_mod.BenchmarkR
     # restores the previous handler for embedding callers (bench.py runs
     # several arms in one process).
     guard = PreemptionGuard()
+    # Hang watchdog created beside the guard (same outside-the-loop
+    # discipline; faults/watchdog.py): its deadline only arms at the
+    # first sync-window beat, so init/XLA-compile time never trips it,
+    # and the finally disarms it for embedding callers.
+    _rank = int(kwargs.get("rank", 0) or 0)
+    watchdog = HangWatchdog(
+        float(kwargs.get("hang_timeout_sec") or 0.0),
+        recorder=recorder,
+        is_main=dist.is_main_process() and _rank == 0,
+        rank=_rank,
+    )
     try:
         if not prng_impl:
             return _run_benchmark_impl(
-                recorder=recorder, preempt_guard=guard, **kwargs
+                recorder=recorder, preempt_guard=guard,
+                hang_watchdog=watchdog, **kwargs
             )
         prev_impl = jax.config.jax_default_prng_impl
         try:
@@ -175,7 +240,8 @@ def run_benchmark(*, prng_impl: str = "rbg", **kwargs) -> metrics_mod.BenchmarkR
             jax.config.update("jax_default_prng_impl", alias)
         try:
             return _run_benchmark_impl(
-                recorder=recorder, preempt_guard=guard, **kwargs
+                recorder=recorder, preempt_guard=guard,
+                hang_watchdog=watchdog, **kwargs
             )
         finally:
             jax.config.update("jax_default_prng_impl", prev_impl)
@@ -185,6 +251,7 @@ def run_benchmark(*, prng_impl: str = "rbg", **kwargs) -> metrics_mod.BenchmarkR
         recorder.abort(f"exception:{type(e).__name__}: {e}")
         raise
     finally:
+        watchdog.disarm()
         guard.uninstall()
 
 
@@ -231,8 +298,12 @@ def _run_benchmark_impl(
     telemetry: bool = True,
     heartbeat_sec: float = 30.0,
     inject_fault: Optional[str] = None,
+    hang_timeout_sec: float = 0.0,
+    sentinel: bool = False,
+    sentinel_checksum_every: int = 0,
     recorder: Optional[TelemetryRecorder] = None,
     preempt_guard: Optional[PreemptionGuard] = None,
+    hang_watchdog: Optional[HangWatchdog] = None,
 ) -> metrics_mod.BenchmarkResult:
     """Benchmark body (see run_benchmark).
 
@@ -243,6 +314,10 @@ def _run_benchmark_impl(
     before (and survives past) this frame. ``inject_fault`` arms one
     deterministic chaos fault (faults.parse_fault_spec grammar; the
     ``INJECT_FAULT`` env var is the flagless fallback).
+    ``hang_timeout_sec`` arms the hang watchdog (``hang_watchdog`` is the
+    wrapper-owned instance so its disarm outlives this frame); ``sentinel``
+    arms the numerics sentinel with ``sentinel_checksum_every`` as the
+    parameter-checksum cadence (0 = checksum guard off).
     """
     if recorder is None:
         # Direct-impl callers (tests) still get phase accounting.
@@ -252,6 +327,22 @@ def _run_benchmark_impl(
         recorder.begin_phase("init")
     is_main = dist.is_main_process() and rank == 0
     preempt = preempt_guard or PreemptionGuard(enabled=False)
+    watchdog = hang_watchdog or HangWatchdog(
+        hang_timeout_sec, recorder=recorder, is_main=is_main, rank=rank,
+    )
+    numerics = (
+        NumericsSentinel(recorder=recorder, is_main=is_main)
+        if sentinel else None
+    )
+    # In-step grad-norm output: SPMD arms only. The pipelined arms run
+    # their loss/backward inside a partially-manual shard_map whose
+    # outputs trip XLA's tile-assignment validation when a replicated
+    # reduction is appended after them (the same u32[4] lowering bug
+    # class as the known interleaved-sharding issue — ROADMAP direction
+    # 3); those arms keep the sentinel's loss-envelope and
+    # parameter-checksum guards, with the grad-norm guard disabled and
+    # announced rather than silently absent.
+    sentinel_in_step = sentinel and pipeline_parallel == 1
     chaos = FaultInjector(
         parse_fault_spec(
             inject_fault if inject_fault is not None
@@ -506,8 +597,12 @@ def _run_benchmark_impl(
         model_config, strategy, mesh, seed=seed, grad_accum=grad_accum,
         from_table=True, global_micro=global_micro, seq_len=seq_len,
         pipeline_schedule=pipeline_schedule, virtual_stages=virtual_stages,
-        abstract_init=dpu_serial_phase,
+        abstract_init=dpu_serial_phase, sentinel=sentinel_in_step,
     )
+    if numerics is not None and not sentinel_in_step and is_main:
+        print("SENTINEL: grad-norm guard unavailable on pipelined arms "
+              "(shard_map lowering); loss-envelope and checksum guards "
+              "remain active")
     serial_state = None
     pending_template = None
     if dpu_serial_phase:
@@ -523,7 +618,7 @@ def _run_benchmark_impl(
             mesh, seed=seed, grad_accum=grad_accum,
             from_table=True, global_micro=global_micro, seq_len=seq_len,
             pipeline_schedule=pipeline_schedule,
-            virtual_stages=virtual_stages,
+            virtual_stages=virtual_stages, sentinel=sentinel_in_step,
         )
     if is_main:
         print(f"Model initialized: {state.n_params/1e6:.2f}M parameters")
@@ -549,7 +644,12 @@ def _run_benchmark_impl(
         table = jax.device_put(ds.data, replicated)
     active_state = serial_state if serial_state is not None else state
     params, opt_state = active_state.params, active_state.opt_state
-    step_times, losses = [], []
+    # Timed stats keyed by step so the sentinel's rollback can truncate
+    # a poisoned tail and the replay can re-measure honestly (replayed
+    # step TIMES stay excluded — their windows fold the restore; the
+    # values are extracted into plain lists for compute_result below).
+    timed_times: list = []   # (step, window-mean step time)
+    timed_losses: list = []  # (step, loss)
     trace_started = False
 
     ckpt = None
@@ -602,7 +702,7 @@ def _run_benchmark_impl(
                     # the orchestration's salvage path (heartbeat partial)
                     # is the honest record of the dead attempt. The
                     # dedicated exception maps to EXIT_NOTHING_TO_RESUME
-                    # (76) in the harness, which the retry wrappers treat
+                    # (77) in the harness, which the retry wrappers treat
                     # as terminal: the refusal is deterministic. The
                     # recorder already truncated telemetry_<arm>.jsonl at
                     # construction — discard it, or the refusal's
@@ -649,7 +749,7 @@ def _run_benchmark_impl(
     # the window is assigned the window's mean — the totals are identical,
     # but N>1 keeps host round-trip latency (dispatch + sync RPCs) out of
     # the hot loop, which matters when the host link is slow.
-    pending: list = []  # (step, loss_handle) since last sync
+    pending: list = []  # (step, loss_handle, gnorm_handle|None) since last sync
     last_loss_box = [None]  # last synced loss — emergency-checkpoint meta
 
     def sync_window(t_start):
@@ -658,9 +758,14 @@ def _run_benchmark_impl(
         Also the telemetry boundary: with the device already fenced, the
         recorder logs the window (step/loss/mean time/HBM sample) and may
         print a heartbeat — the only sanctioned place for telemetry IO in
-        the loop (graftcheck GC105). The chaos injector's boundary hook
-        fires here too, AFTER the window's telemetry committed: a fault's
-        trail always records the window it killed.
+        the loop (graftcheck GC105). The numerics sentinel judges each
+        synced step here (host floats only; a trip is handled at the top
+        of the next loop iteration, before anything dispatches on the
+        poisoned state), the hang watchdog is beaten, and the chaos
+        injector's boundary hook fires LAST, after the window's telemetry
+        committed: a fault's trail always records the window it killed —
+        and an injected hang stalls with the beat already recorded, so
+        the watchdog measures the stall itself.
         """
         if not pending:
             return
@@ -668,21 +773,102 @@ def _run_benchmark_impl(
         dt = (time.perf_counter() - t_start) / len(pending)
         last = pending[-1][0]
         window_losses = []
-        for s, l in pending:
+        for s, l, g in pending:
             lf = float(l)
             window_losses.append(lf)
             if s >= warmup_steps:
-                step_times.append(dt)
-                losses.append(lf)
+                if s > cursor.replay_until:
+                    timed_times.append((s, dt))
+                timed_losses.append((s, lf))
             if is_main and s % log_every == 0:
                 print(f"[Step {s:04d}] Loss: {lf:.4f}, Time: {dt:.3f}s")
+            if numerics is not None:
+                numerics.observe(
+                    s, lf, float(g) if g is not None else None
+                )
         recorder.step_window(
             last_step=last, losses=window_losses,
             window_mean_step_time_sec=dt,
         )
         last_loss_box[0] = window_losses[-1]
         pending.clear()
+        watchdog.beat(last)
         chaos.at_boundary(last)
+
+    param_norm_fn = None
+    last_checksum_box = [start_step]
+
+    def _observe_checksum(at_step):
+        """Sentinel parameter-tree checksum at one fenced boundary.
+
+        One jitted global-norm reduction + a scalar host read — device
+        work, but off the timed path (the caller restarts the window
+        clock after). The jit is built lazily on first use and cache-hits
+        thereafter.
+        """
+        nonlocal param_norm_fn
+        if param_norm_fn is None:
+            from .step import make_param_norm_fn
+
+            param_norm_fn = make_param_norm_fn(mesh)
+        numerics.observe_param_checksum(at_step, float(param_norm_fn(params)))
+
+    def _prepare_rollback():
+        """Restore the last validated checkpoint for an open sentinel trip.
+
+        Returns ``((params, opt_state, restored_step), trip_step)``; when
+        healing is impossible — no checkpointer, no validated step behind
+        the run, or MAX_ROLLBACKS exhausted — raises
+        :class:`faults.SentinelTripped` so the run fails LOUDLY instead of
+        publishing (or endlessly replaying) a poisoned measurement.
+        """
+        trip = numerics.trip
+        if ckpt is None:
+            raise SentinelTripped(
+                trip["kind"], trip["step"],
+                f"{trip['detail']}; no --checkpoint-dir to roll back to",
+            )
+        if not numerics.rollback_allowed:
+            raise SentinelTripped(
+                trip["kind"], trip["step"],
+                f"{trip['detail']}; {numerics.n_rollbacks} rollback(s) "
+                "already spent — persistent numerics failure, not a "
+                "transient",
+            )
+        recorder.begin_phase("checkpoint")
+        restored = ckpt.restore_latest(params, opt_state)
+        if restored is None:
+            raise SentinelTripped(
+                trip["kind"], trip["step"],
+                f"{trip['detail']}; no validated checkpoint committed yet",
+            )
+        return restored, trip["step"]
+
+    def _after_rollback(rb_step, tripped_at):
+        """Bookkeeping half of a rollback: truncate the poisoned tail out
+        of the timed stats, record the ledger + telemetry event, and
+        re-open the right phase for the replay."""
+        timed_times[:] = [e for e in timed_times if e[0] <= rb_step]
+        timed_losses[:] = [e for e in timed_losses if e[0] <= rb_step]
+        numerics.note_rollback(from_step=tripped_at, to_step=rb_step)
+        recorder.begin_phase(
+            "timed" if rb_step + 1 >= warmup_steps else "warmup"
+        )
+
+    def _roll_back_if_tripped():
+        """The whole heal for an open trip: restore + bookkeeping +
+        cursor rewind. Returns the restored ``(params, opt_state)`` (the
+        caller rebinds its locals and restarts the window clock), or
+        None when no trip is open. ONE implementation for both trip
+        sources — the window observation and the checksum — so the two
+        paths can never diverge."""
+        if numerics.trip is None:
+            return None
+        restored, tripped_at = _prepare_rollback()
+        rb_params, rb_opt, rb_step = restored
+        _after_rollback(rb_step, tripped_at)
+        cursor.rollback(rb_step, tripped_at)
+        return rb_params, rb_opt
 
     def _emergency_stop(at_step):
         """SIGTERM landed: checkpoint at this fenced boundary and stop.
@@ -773,9 +959,29 @@ def _run_benchmark_impl(
         # complete yet (and stopping alone would wedge their collectives).
         _emergency_stop(start_step - 1)
 
+    watchdog.start()
     recorder.begin_phase("compile")
     t_window = time.perf_counter()
-    for step in range(start_step, steps):
+    cursor = _StepCursor(start_step, steps)
+    for step in cursor:
+        # Sentinel boundary work FIRST (pending empty == the previous
+        # iteration ended at a fenced boundary): an open trip must be
+        # rolled back before anything dispatches on the poisoned state —
+        # in particular before a periodic checkpoint could persist it.
+        if numerics is not None and not pending:
+            rolled = _roll_back_if_tripped()
+            if rolled is None and (
+                sentinel_checksum_every > 0
+                and step - last_checksum_box[0] >= sentinel_checksum_every
+            ):
+                last_checksum_box[0] = step
+                _observe_checksum(step - 1)
+                t_window = time.perf_counter()
+                rolled = _roll_back_if_tripped()
+            if rolled is not None:
+                params, opt_state = rolled
+                t_window = time.perf_counter()
+                continue
         if profile_dir and step == warmup_steps and is_main and not trace_started:
             sync_window(t_window)
             recorder.begin_phase("trace")
@@ -816,9 +1022,36 @@ def _run_benchmark_impl(
             if is_main:
                 print(f"[Step {step:04d}] delayed-update phase begins")
             t_window = time.perf_counter()
-        params, opt_state, loss = active_state.step_fn(params, opt_state, table, step)
+        # Chaos param corruption (bitflip/grad-explode): poisons the
+        # pre-dispatch handle exactly once at its armed step — the
+        # sentinel-proof injection point. Inert (one attribute check)
+        # when not armed.
+        params = chaos.corrupt_params(step, params)
+        if numerics is None:
+            params, opt_state, loss = active_state.step_fn(
+                params, opt_state, table, step
+            )
+            gnorm = None
+        elif sentinel_in_step:
+            # Sentinel-armed step: fourth output is the in-step global
+            # grad-norm. The step index is shifted by whole-run strides
+            # per rollback (data_reseeds) so a replay draws fresh batch
+            # rows and dropout keys instead of re-consuming the poisoned
+            # sequence.
+            params, opt_state, loss, gnorm = active_state.step_fn(
+                params, opt_state, table,
+                step + numerics.data_reseeds * steps,
+            )
+        else:
+            # Pipelined sentinel arm: no in-step grad-norm (see the
+            # sentinel_in_step note above) — same reseeded step fold.
+            params, opt_state, loss = active_state.step_fn(
+                params, opt_state, table,
+                step + numerics.data_reseeds * steps,
+            )
+            gnorm = None
         loss = chaos.corrupt_loss(step, loss)
-        pending.append((step, loss))
+        pending.append((step, loss, gnorm))
         if step == start_step and step < warmup_steps:
             # Fence the first dispatched step on its own: its wall time is
             # dominated by the XLA compile, and attributing it to the
@@ -852,24 +1085,48 @@ def _run_benchmark_impl(
             and (serial_state is None or step >= offload_dpu_start_step)
         ):
             sync_window(t_window)
-            recorder.begin_phase("checkpoint")
-            try:
-                chaos.maybe_fail_save()
-                ckpt.save(step, params, opt_state,
-                          meta={"last_loss": last_loss_box[0]})
+            if numerics is not None and numerics.trip is None:
+                # Pre-save checksum, unconditional under the sentinel
+                # (independent of the --sentinel-checksum-every cadence):
+                # "roll back to the last VALIDATED checkpoint" is only
+                # true if no save can ever persist a state the checksum
+                # guard would reject — without this, an SDC that slips
+                # between cadence points gets checkpointed and the
+                # rollback would faithfully restore the poison. Also
+                # advances the cadence clock: with aligned cadences the
+                # periodic branch would otherwise recompute the identical
+                # norm at the very next boundary.
+                last_checksum_box[0] = step
+                _observe_checksum(step)
+            if numerics is not None and numerics.trip is not None:
+                # A sentinel guard tripped in the window this boundary just
+                # closed (or the pre-save checksum just failed): persisting
+                # the state now would CHECKPOINT THE POISON and make every
+                # future rollback restore it. Skip the save; the rollback
+                # handler runs at the top of the next iteration, before
+                # anything else dispatches.
                 if is_main:
-                    mode = " (async dispatch)" if checkpoint_async else ""
-                    print(f"Checkpoint saved at step {step}{mode}")
-                chaos.after_save(ckpt, step)
-            except OSError as e:
-                # A full disk (ENOSPC et al.) must degrade the checkpoint
-                # cadence, never kill the benchmark: the run finishes on
-                # its older checkpoints, and the telemetry trail says why
-                # the cadence has a hole.
-                recorder.note("checkpoint_failed", step=step, error=str(e))
-                if is_main:
-                    print(f"WARNING: checkpoint save at step {step} failed "
-                          f"({e}); continuing without")
+                    print(f"SENTINEL: skipping checkpoint save at step "
+                          f"{step} (open {numerics.trip['kind']} trip)")
+            else:
+                recorder.begin_phase("checkpoint")
+                try:
+                    chaos.maybe_fail_save()
+                    ckpt.save(step, params, opt_state,
+                              meta={"last_loss": last_loss_box[0]})
+                    if is_main:
+                        mode = " (async dispatch)" if checkpoint_async else ""
+                        print(f"Checkpoint saved at step {step}{mode}")
+                    chaos.after_save(ckpt, step)
+                except OSError as e:
+                    # A full disk (ENOSPC et al.) must degrade the checkpoint
+                    # cadence, never kill the benchmark: the run finishes on
+                    # its older checkpoints, and the telemetry trail says why
+                    # the cadence has a hole.
+                    recorder.note("checkpoint_failed", step=step, error=str(e))
+                    if is_main:
+                        print(f"WARNING: checkpoint save at step {step} failed "
+                              f"({e}); continuing without")
             recorder.begin_phase("timed" if step >= warmup_steps else "warmup")
             t_window = time.perf_counter()
         # Preemption poll — last statement of the body, so a SIGTERM that
@@ -889,6 +1146,15 @@ def _run_benchmark_impl(
         # resume that deterministically refuses — the post-loop branch
         # publishes instead.
         if not pending:
+            # Cross-host hang coherence (faults/watchdog.py): a peer whose
+            # watchdog fired published a hang flag; this rank is healthy
+            # (it reached a boundary) but the RUN is hung — join the
+            # coherent EXIT_HUNG abort instead of finishing a half-world
+            # measurement. Non-blocking ~1ms KV poll, armed runs only.
+            peer_hang = watchdog.peer_hang()
+            if peer_hang is not None:
+                watchdog.disarm()
+                abort_on_peer_hang(recorder, step, peer_hang)
             preempt_target = preempt.coordinate(step)
             if (
                 preempt_target is not None
@@ -898,6 +1164,23 @@ def _run_benchmark_impl(
                 _emergency_stop(step)
 
     sync_window(t_window)
+    # Refresh the deadline at loop exit: the watchdog stays armed through
+    # the final checkpoint save and the cross-host barrier below — the
+    # barrier is exactly where a one-stalled-rank hang wedges every
+    # HEALTHY rank (a rank that raced ahead blocks there forever), and
+    # the watchdog firing inside it is what turns that into a coherent
+    # all-host exit 76 instead of a coordination-service crash code.
+    watchdog.beat(steps - 1)
+    if numerics is not None and numerics.trip is not None:
+        # A guard tripped at the very last boundary: there are no steps
+        # left to replay the poison out of, so publishing would put the
+        # corrupted tail into the row. Fail loudly instead.
+        _trip = numerics.trip
+        raise SentinelTripped(
+            _trip["kind"], _trip["step"],
+            f"{_trip['detail']}; tripped at the final boundary — nothing "
+            "left to replay, not publishing a poisoned row",
+        )
     if preempt.requested and is_main:
         # SIGTERM during the final window: every step already executed
         # and synced, so aborting would promise a resume that has NOTHING
@@ -915,6 +1198,18 @@ def _run_benchmark_impl(
         # cadence dividing steps-1 lands the periodic save there first;
         # orbax refuses same-step overwrites even with force=True).
         if start_step < steps and ckpt.latest_step() != steps - 1:
+            if numerics is not None:
+                # Final-state checksum: the last committed checkpoint is
+                # what every future --resume restores, so a poisoned
+                # final state must fail the run loudly, not be enshrined.
+                _observe_checksum(steps - 1)
+                if numerics.trip is not None:
+                    _trip = numerics.trip
+                    raise SentinelTripped(
+                        _trip["kind"], _trip["step"],
+                        f"{_trip['detail']}; final-state checksum failed — "
+                        "not committing a poisoned final checkpoint",
+                    )
             try:
                 chaos.maybe_fail_save()
                 ckpt.save(steps - 1, params, opt_state, force=True,
@@ -925,6 +1220,12 @@ def _run_benchmark_impl(
                 if is_main:
                     print(f"WARNING: final checkpoint save failed ({e})")
         ckpt.close()
+        # The final save/close is legitimate watchdog-covered time, but it
+        # is IO, not cadence: refresh the deadline so the barrier below
+        # gets the full timeout budget (operators must still size
+        # --hang-timeout-sec above their slowest checkpoint write —
+        # docs/FAULT_TOLERANCE.md).
+        watchdog.beat(steps - 1)
     if trace_started:
         # stop_trace serializes the Chrome trace to disk — seconds for a
         # large run; bracket it so that cost attributes to 'trace', not to
@@ -938,6 +1239,12 @@ def _run_benchmark_impl(
     recorder.begin_phase("finalize")
 
     dist.barrier()
+    # Past the barrier every rank is provably alive and synced: nothing
+    # beats the watchdog again, and the remaining finalize work (AOT
+    # memory accounting, diagnostics, result emission) is single-host and
+    # unbounded — that stretch belongs to the external liveness probe
+    # (scripts/liveness_probe.sh).
+    watchdog.disarm()
 
     # Fetch the step executable for XLA's measured memory accounting — only
     # needed when the allocator can't report a peak itself (measure_peak_hbm
@@ -1043,6 +1350,11 @@ def _run_benchmark_impl(
             if is_main:
                 print(f"WARNING: MoE overflow diagnostic skipped: {e}")
 
+    # Extract the timed distributions from their step-keyed form (the
+    # sentinel's rollback truncation is why they carry step ids at all);
+    # replayed steps are absent from timed_times by construction.
+    step_times = [dt for _s, dt in timed_times]
+    losses = [lf for _s, lf in timed_losses]
     result = metrics_mod.compute_result(
         strategy=strategy.name,
         world_size=world_size,
@@ -1054,6 +1366,10 @@ def _run_benchmark_impl(
         grad_accum=grad_accum,
         step_times=step_times,
         losses=losses,
+        n_rollbacks=numerics.n_rollbacks if numerics is not None else 0,
+        rollback_steps_replayed=(
+            numerics.rollback_steps_replayed if numerics is not None else 0
+        ),
         device_kind=devices[0].device_kind,
         backend=jax.default_backend(),
         n_params=state.n_params,

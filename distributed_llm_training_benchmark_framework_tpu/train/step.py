@@ -39,7 +39,10 @@ class TrainState:
 
     params: Params
     opt_state: Any
-    step_fn: Callable  # (params, opt_state, batch, step) -> (params, opt_state, loss)
+    # (params, opt_state, batch, step) -> (params, opt_state, loss)
+    # — plus a trailing global grad-norm scalar when built with
+    # make_train_step(sentinel=True) (the numerics sentinel's guard).
+    step_fn: Callable
     # (params, opt_state, batch, step) -> jax.stages.Compiled for the step —
     # cache hit after the first execution; feeds measure_peak_hbm rung 2.
     aot_compile: Callable
@@ -135,6 +138,44 @@ def zero2_block_grad_spec(
     return per_block or None
 
 
+def global_norm_f32(tree) -> jax.Array:
+    """Global L2 norm of a pytree, accumulated in f32.
+
+    The numerics sentinel's on-device guard primitive: for sharded trees
+    the per-shard partial sums reduce through the mesh automatically (the
+    scalar output is replicated), so the value is the GLOBAL norm on
+    every strategy arm. f32 accumulation keeps ordinary magnitudes exact
+    while a genuinely exploded tree still overflows to inf — which is a
+    trip, not a rounding problem.
+    """
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype")]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    )
+
+
+def make_param_norm_fn(mesh: Mesh) -> Callable:
+    """Jitted parameter-tree checksum (global L2 norm) for the sentinel.
+
+    One replicated f32 scalar per call; the loop invokes it only at
+    sync-window boundaries every ``--sentinel-checksum-every`` steps
+    (params are read-only here — a diagnostic reduction, not an update).
+    """
+    jitted = jax.jit(
+        global_norm_f32,
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+    def checksum(params):
+        with jax.set_mesh(mesh):
+            return jitted(params)
+
+    return checksum
+
+
 def make_train_step(
     model_config: tinygpt.TinyGPTConfig,
     strategy: strat.StrategyConfig,
@@ -150,6 +191,7 @@ def make_train_step(
     seq_len: int = 0,
     pipeline_schedule: str = "gpipe",
     virtual_stages: int = 2,
+    sentinel: bool = False,
 ) -> Callable:
     """Build the jitted train step for one strategy arm.
 
@@ -163,6 +205,14 @@ def make_train_step(
     TPU-native answer to the reference's DataLoader (whose synthetic tensor
     also lives device-side after first touch). Requires ``global_micro`` and
     ``seq_len`` for the gather geometry.
+
+    ``sentinel=True`` (numerics-sentinel round) makes the step return a
+    FOURTH output: the global grad-norm (f32, replicated — see
+    :func:`global_norm_f32`), computed inside the jitted step so the
+    sentinel's explosion guard costs one fused reduction instead of a
+    second device round-trip. Off by default: the extra all-reduce would
+    shift every arm's frozen collective budget, so only sentinel-armed
+    runs compile it (the HLO auditor compiles with the default).
     """
     cfg = _resolve_model_config(model_config, strategy, mesh)
     grad_sharded_specs = strat.param_partition_specs(
@@ -271,6 +321,11 @@ def make_train_step(
             loss = loss_sum / grad_accum
             grads = jax.tree.map(lambda g: g / grad_accum, grads)
 
+        # Sentinel guard value: the global grad-norm, BEFORE any layout
+        # constraint (the norm is layout-invariant; computing it here lets
+        # XLA fuse the partial sums into the backward pass it just ran).
+        gnorm = global_norm_f32(grads) if sentinel else None
+
         if strategy.shard_grads:
             # Pin the gradient layout for every sharded-grad strategy.
             # For zero2 this IS the semantics (reduce-scatter into the
@@ -298,6 +353,8 @@ def make_train_step(
                 ) else param_specs,
                 param_specs,
             )
+            if sentinel:
+                return new_params, new_opt_state, loss, gnorm
             return new_params, new_opt_state, loss
 
         updates, new_opt_state = optimizer.update(grads, opt_state, params)
@@ -307,9 +364,19 @@ def make_train_step(
             updates = lax.with_sharding_constraint(updates, strat.named(mesh, param_specs))
 
         new_params = optax.apply_updates(params, updates)
+        if sentinel:
+            return new_params, new_opt_state, loss, gnorm
         return new_params, new_opt_state, loss
 
     opt_shardings = strat.opt_state_shardings(mesh, opt_specs, strategy)
+    scalar = NamedSharding(mesh, P())
+    out_shardings = (
+        strat.named(mesh, param_specs),
+        opt_shardings,
+        scalar,
+    )
+    if sentinel:
+        out_shardings = out_shardings + (scalar,)
     jitted = jax.jit(
         train_step,
         in_shardings=(
@@ -319,11 +386,7 @@ def make_train_step(
             else NamedSharding(mesh, full_batch_spec),
             None,
         ),
-        out_shardings=(
-            strat.named(mesh, param_specs),
-            opt_shardings,
-            NamedSharding(mesh, P()),
-        ),
+        out_shardings=out_shardings,
         donate_argnums=(0, 1),
     )
 
@@ -488,6 +551,7 @@ def create_train_state(
     pipeline_schedule: str = "gpipe",
     virtual_stages: int = 2,
     abstract_init: bool = False,
+    sentinel: bool = False,
 ) -> TrainState:
     """Initialize params + optimizer state directly into their target shardings.
 
@@ -572,6 +636,7 @@ def create_train_state(
         seq_len=seq_len,
         pipeline_schedule=pipeline_schedule,
         virtual_stages=virtual_stages,
+        sentinel=sentinel,
     )
     return TrainState(
         params=params,

@@ -312,6 +312,18 @@ class BenchmarkResult:
     n_restarts: int = 0
     resume_step: int = -1
     resume_baseline_loss: float = 0.0
+    # Numerics-sentinel accounting (self-healing round, docs/
+    # FAULT_TOLERANCE.md): how many times the run rolled back in-process
+    # to its last validated checkpoint after a sentinel trip (NaN/loss
+    # envelope/grad explosion/parameter-checksum SDC), and how many steps
+    # those rollbacks replayed. Replayed steps are EXCLUDED from the
+    # timed step-time distribution (their windows fold the restore);
+    # validate_results checks the two fields cohere, and the regress
+    # registry keeps rolled-back rows out of the baseline set exactly
+    # like resumed/partial ones — a healed run is an honest record but
+    # not a clean measurement.
+    n_rollbacks: int = 0
+    rollback_steps_replayed: int = 0
     # True when the resume crossed a mesh-geometry change (elastic resume:
     # the checkpoint was saved under a different dp/tp/sp/pp/ep mesh and
     # was reshard-restored against this run's PartitionSpecs). Implies
@@ -420,6 +432,8 @@ def compute_result(
     resume_step: int = -1,
     resume_baseline_loss: float = 0.0,
     resume_geometry_changed: bool = False,
+    n_rollbacks: int = 0,
+    rollback_steps_replayed: int = 0,
     prior_peak_bytes: Optional[int] = None,
     wall_time_total_sec: float = 0.0,
     phase_times: Optional[Dict[str, float]] = None,
@@ -553,6 +567,8 @@ def compute_result(
         resume_step=resume_step,
         resume_baseline_loss=round(resume_baseline_loss, 6),
         resume_geometry_changed=resume_geometry_changed,
+        n_rollbacks=n_rollbacks,
+        rollback_steps_replayed=rollback_steps_replayed,
         wall_time_total_sec=round(wall_time_total_sec, 4),
         time_in_init_sec=round(pt.get("init", 0.0), 4),
         time_in_compile_sec=round(pt.get("compile", 0.0), 4),
@@ -632,6 +648,12 @@ def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True)
             f"  RESUMED:          from step {result.resume_step} "
             f"(restart #{result.n_restarts}{stitch}) — stitched run, "
             "never a regression baseline"
+        )
+    if result.n_rollbacks > 0:
+        print(
+            f"  ROLLBACKS:        {result.n_rollbacks} sentinel "
+            f"rollback(s), {result.rollback_steps_replayed} step(s) "
+            "replayed — healed run, never a regression baseline"
         )
     print("=" * 80 + "\n")
 

@@ -83,9 +83,22 @@ export CHECKPOINT_EVERY="${CHECKPOINT_EVERY:-}"
 export RESUME="${RESUME:-0}"
 export DEBUG="${DEBUG:-0}"
 # Chaos harness (faults/, docs/FAULT_TOLERANCE.md): arm one deterministic
-# fault (sigkill@N / sigterm@N / nan-loss@N / hang@N / torn-checkpoint /
-# enospc-on-save) — chaos pods prove the recovery path on real slices.
+# fault (sigkill@N / sigterm@N / nan-loss@N / hang@N / stall-rank@N:R /
+# bitflip@N / grad-explode@N / torn-checkpoint / enospc-on-save) — chaos
+# pods prove the recovery path on real slices.
 export INJECT_FAULT="${INJECT_FAULT:-}"
+# Self-healing loop (faults/watchdog.py + faults/sentinel.py): in-process
+# hang watchdog (seconds; 0 = off — MUST stay below the liveness probe's
+# LIVENESS_GRACE_SEC so the stack-dump abort wins the race, see
+# scripts/liveness_probe.sh) and the numerics sentinel's
+# rollback-and-replay guards.
+export HANG_TIMEOUT_SEC="${HANG_TIMEOUT_SEC:-}"
+# SENTINEL accepts the harness's on|off AND this file's 0/1 boolean
+# convention (CHECKPOINT_ASYNC=1 et al.) — an operator mirroring the
+# sibling toggles must not crash argparse.
+export SENTINEL="${SENTINEL:-}"
+case "$SENTINEL" in 1) SENTINEL=on ;; 0) SENTINEL="" ;; esac
+export SENTINEL_CHECKSUM_EVERY="${SENTINEL_CHECKSUM_EVERY:-}"
 # In-pod retry loop: 0 (default) keeps the exec'd single-attempt path
 # (python as PID 1 — the preStop/terminationGrace SIGTERM contract).
 # N > 0 execs scripts/with_retries.sh as PID 1 instead — ONE retry
@@ -201,6 +214,12 @@ if [ "${DEBUG}" = "1" ]; then ARGS="${ARGS} --debug"; fi
 if [ "${CHECKPOINT_ASYNC}" = "1" ]; then ARGS="${ARGS} --checkpoint-async"; fi
 if [ -n "${INJECT_FAULT}" ]; then
   ARGS="${ARGS} --inject-fault ${INJECT_FAULT}"; fi
+if [ -n "${HANG_TIMEOUT_SEC}" ]; then
+  ARGS="${ARGS} --hang-timeout-sec ${HANG_TIMEOUT_SEC}"; fi
+if [ -n "${SENTINEL}" ]; then
+  ARGS="${ARGS} --sentinel ${SENTINEL}"; fi
+if [ -n "${SENTINEL_CHECKSUM_EVERY}" ]; then
+  ARGS="${ARGS} --sentinel-checksum-every ${SENTINEL_CHECKSUM_EVERY}"; fi
 
 # GRAFTCHECK=1: run the static preflight (collective-budget audit + lint,
 # scripts/graftcheck.sh) before launching. Runs on the container's host CPU

@@ -5,18 +5,31 @@
 #
 #   - a completed, validated result (after resume where the class allows
 #     recovery): sigkill, sigterm, torn-checkpoint, enospc-on-save;
+#   - a completed, validated result WITHOUT any restart (self-healing
+#     round): bitflip and grad-explode trip the numerics sentinel, which
+#     rolls back in-process to the last validated checkpoint and replays
+#     — the row publishes n_rollbacks=1 and its registry record is never
+#     a gate baseline;
 #   - a correctly classified failure: nan-loss completes but
 #     validate_results REJECTS the row (unresolved anomaly); hang is
-#     killed by the timeout and salvages into a partial_<arm>.json.
+#     caught by the IN-PROCESS watchdog (--hang-timeout-sec), which dumps
+#     all-thread stacks into a hang_dump telemetry event and exits the
+#     distinct retryable code 76 — no external timeout or liveness probe
+#     involved — and the arm then RESUMES to a validated result;
+#     stall-rank proves the hang abort is COHERENT across ranks (the
+#     stuck rank's watchdog broadcasts over the coordination-service KV
+#     store; every rank exits 76).
 #
 # Faults fire at exact sync-window boundaries (faults/injection.py), so
 # the whole suite is reproducible: same spec, same abort step, every run.
 #
 #   chaos_suite.sh                 # full matrix on the tinygpt smoke config
-#   chaos_suite.sh --smoke         # 2-fault smoke (sigkill + torn-checkpoint)
+#   chaos_suite.sh --smoke         # 3-fault smoke (sigkill + torn-checkpoint
+#                                  #   + bitflip sentinel-rollback)
 #   chaos_suite.sh --faults "sigterm hang" --results-dir /tmp/chaos
-#   chaos_suite.sh --elastic       # + geometry-change resume proof
-#                                  #   (save@dp4 -> resume@dp2 -> validated)
+#   chaos_suite.sh --elastic       # + geometry-change resume proofs
+#                                  #   (save@dp4 -> resume@dp2, and
+#                                  #    save@tp2 -> resume@tp1 — validated)
 #   chaos_suite.sh --k8s-chaos     # + coordinator-pod-death recovery proof
 #                                  #   (fake kubectl, Indexed Job relaunch)
 #
@@ -43,14 +56,14 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 REPO_ROOT="$(pwd)"
 
-FAULTS="sigkill sigterm sigterm-rank nan-loss hang torn-checkpoint enospc-on-save"
+FAULTS="sigkill sigterm sigterm-rank nan-loss hang stall-rank bitflip grad-explode torn-checkpoint enospc-on-save"
 ROOT=""
 KEEP=0
 ELASTIC=0
 K8S_CHAOS=0
 while [ $# -gt 0 ]; do
   case "$1" in
-    --smoke) FAULTS="sigkill torn-checkpoint"; shift ;;
+    --smoke) FAULTS="sigkill torn-checkpoint bitflip"; shift ;;
     --faults) FAULTS="$2"; shift 2 ;;
     --elastic) ELASTIC=1; shift ;;
     --k8s-chaos) K8S_CHAOS=1; shift ;;
@@ -59,7 +72,7 @@ while [ $# -gt 0 ]; do
     *) echo "chaos_suite: unknown flag $1" >&2; exit 2 ;;
   esac
 done
-[ "$ELASTIC" = "1" ] && FAULTS="$FAULTS elastic"
+[ "$ELASTIC" = "1" ] && FAULTS="$FAULTS elastic elastic-tp"
 [ "$K8S_CHAOS" = "1" ] && FAULTS="$FAULTS k8s-coordinator"
 if [ -z "$ROOT" ]; then
   ROOT="$(mktemp -d /tmp/chaos_suite.XXXXXX)"
@@ -185,22 +198,114 @@ for fault in $FAULTS; do
       ok "$fault" "run completed; validator correctly rejected the row"
       ;;
     hang)
+      # Self-healing round: the IN-PROCESS watchdog catches the stall —
+      # the external `timeout` below is only a backstop that must never
+      # fire (a 124/137 here means the watchdog is broken).
       timeout -k 5 "${CHAOS_HANG_TIMEOUT:-60}" \
         "${HARNESS[@]}" --results-dir "$dir/results" \
         --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+        --hang-timeout-sec 5 \
         --inject-fault "hang@6:600" > "$dir/phase1.log" 2>&1
       rc=$?
-      if [ "$rc" -ne 124 ] && [ "$rc" -ne 137 ]; then
-        fail "$fault" "expected a timeout kill (124/137), got rc=$rc"; continue
+      if [ "$rc" -ne 76 ]; then
+        fail "$fault" "expected the watchdog's EXIT_HUNG (76), got rc=$rc"; continue
+      fi
+      if ! grep -aq '"event": "hang_dump"' "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "no hang_dump stack-dump telemetry event"; continue
+      fi
+      if ! grep -aq '"event": "run_aborted".*"reason": "hang"' \
+           "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "no run_aborted reason=hang telemetry event"; continue
       fi
       if ! scripts/collect_results.sh --log "$dir/phase1.log" \
            "$dir/salvage" > "$dir/collect.log" 2>&1; then
         fail "$fault" "heartbeat salvage failed (see $dir/collect.log)"; continue
       fi
-      if ! ls "$dir/salvage"/partial_*.json > /dev/null 2>&1; then
-        fail "$fault" "no partial_<arm>.json salvaged"; continue
+      if ! grep -q '"reason": "hang"' "$dir/salvage"/partial_*.json; then
+        fail "$fault" "salvaged partial row not classified reason=hang"; continue
       fi
-      ok "$fault" "hang killed by timeout; classified as a partial row"
+      check_recovered "$fault" "$dir"
+      ;;
+    bitflip|grad-explode)
+      # Numerics-sentinel heal: the fault poisons the params mid-run, a
+      # guard trips, the loop rolls back to the last VALIDATED checkpoint
+      # and replays — the run completes IN PROCESS (rc 0, no restart),
+      # publishes n_rollbacks=1, passes validate_results, and its
+      # registry record is never a gate baseline.
+      run_arm "$dir" "$dir/phase1.log" \
+        --sentinel on --sentinel-checksum-every "$CKPT_EVERY" \
+        --inject-fault "$fault@9"
+      rc=$?
+      if [ "$rc" -ne 0 ]; then
+        fail "$fault" "sentinel should heal in-process (rc=0), got rc=$rc"; continue
+      fi
+      row="$dir/results/result_ddp_ws1_seq32_tierS.json"
+      if [ ! -f "$row" ]; then fail "$fault" "no result row"; continue; fi
+      if ! python - "$row" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["n_rollbacks"] == 1, f"n_rollbacks={r['n_rollbacks']}"
+assert r["rollback_steps_replayed"] >= 1, \
+    f"rollback_steps_replayed={r['rollback_steps_replayed']}"
+assert r["resumed"] is False, "heal must not be a restart"
+EOF
+      then fail "$fault" "healed row missing honest rollback accounting"; continue; fi
+      if ! grep -aq '"event": "sentinel_trip"' "$dir/results"/telemetry_*.jsonl \
+         || ! grep -aq '"event": "rollback"' "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "telemetry missing sentinel_trip/rollback events"; continue
+      fi
+      if ! validate "$dir"; then
+        fail "$fault" "validate_results rejected the healed row (see $dir/validate.log)"
+        continue
+      fi
+      # Never-baseline proof: ingest into a throwaway registry; the gate
+      # must SKIP the rolled-back candidate, not verdict from it.
+      if ! python -m distributed_llm_training_benchmark_framework_tpu.regress \
+           --registry "$dir/registry" ingest --results-dir "$dir/results" \
+           > "$dir/regress.log" 2>&1; then
+        fail "$fault" "registry ingest of the healed row failed"; continue
+      fi
+      if ! python -m distributed_llm_training_benchmark_framework_tpu.regress \
+           --registry "$dir/registry" gate --all >> "$dir/regress.log" 2>&1 \
+         || ! grep -q "rolled-back (sentinel-healed)" "$dir/regress.log"; then
+        fail "$fault" "gate did not SKIP the rolled-back record as never-baseline"
+        continue
+      fi
+      ok "$fault" "sentinel tripped, rolled back + replayed in-process; row validated, never a baseline"
+      ;;
+    stall-rank)
+      # Coherent all-host hang abort (self-healing round): rank 1 stalls;
+      # its watchdog dumps + broadcasts over the coordination-service KV
+      # store, and BOTH ranks must exit the same EXIT_HUNG (76) — no
+      # external timeout, no liveness probe, no coordination-service
+      # crash code.
+      port=$((29820 + RANDOM % 200))
+      timeout -k 5 "${CHAOS_MH_TIMEOUT:-180}" \
+        "${HARNESS[@]}" --rank 0 --num-processes 2 \
+        --master-addr 127.0.0.1 --master-port "$port" \
+        --hang-timeout-sec 5 \
+        --results-dir "$dir/results" \
+        --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+        --inject-fault "stall-rank@6:1:600" > "$dir/rank0.log" 2>&1 &
+      pid0=$!
+      timeout -k 5 "${CHAOS_MH_TIMEOUT:-180}" \
+        "${HARNESS[@]}" --rank 1 --num-processes 2 \
+        --master-addr 127.0.0.1 --master-port "$port" \
+        --hang-timeout-sec 5 \
+        --results-dir "$dir/results1" \
+        --checkpoint-dir "$dir/ckpt1" --checkpoint-every "$CKPT_EVERY" \
+        --inject-fault "stall-rank@6:1:600" > "$dir/rank1.log" 2>&1 &
+      pid1=$!
+      wait "$pid0"; rc0=$?
+      wait "$pid1"; rc1=$?
+      if [ "$rc0" -ne 76 ] || [ "$rc1" -ne 76 ]; then
+        fail "$fault" "expected unanimous EXIT_HUNG (76/76), got rc0=$rc0 rc1=$rc1"
+        continue
+      fi
+      if ! grep -aq '"event": "hang_dump"' "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "rank 0 has no hang_dump stack-dump event"; continue
+      fi
+      ok "$fault" "rank-1 stall aborted BOTH ranks coherently at 76 with stack dumps"
       ;;
     sigterm-rank)
       # Multihost dryrun (elastic-resilience round): two harness
@@ -286,6 +391,49 @@ EOF
         continue
       fi
       ok "$fault" "dp4 checkpoint resumed under dp2; resume_geometry_changed=true validated"
+      ;;
+    elastic-tp)
+      # Chaos follow-up (e) from the ROADMAP: the tp-CHANGE arm — a
+      # checkpoint saved under a tensor-parallel mesh (dp2 x tp2) resumes
+      # under tp1 (dp2) through the reshard-on-restore path. Previously
+      # unit-tested only; this is the subprocess proof.
+      EHARNESS=(python -u benchmarking/train_harness.py
+                --strategy fsdp --rank 0 --tier S --seq-len 32
+                --steps "$STEPS" --warmup-steps "$WARMUP"
+                --per-device-batch 1 --grad-accum 1 --dataset-size 64
+                --heartbeat-sec 0 --sync-every 2)
+      "${EHARNESS[@]}" --world-size 4 --tensor-parallel 2 \
+        --results-dir "$dir/results" \
+        --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+        --inject-fault "sigkill@9" > "$dir/phase1.log" 2>&1
+      rc=$?
+      if [ "$rc" -eq 0 ]; then fail "$fault" "run survived its own SIGKILL (rc=0)"; continue; fi
+      if ! ls "$dir/ckpt" 2>/dev/null | grep -q '^[0-9]*$'; then
+        fail "$fault" "no tp2 checkpoint committed before the kill"; continue
+      fi
+      if ! "${EHARNESS[@]}" --world-size 2 --results-dir "$dir/results" \
+           --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+           --resume > "$dir/resume.log" 2>&1; then
+        fail "$fault" "tp1 resume did not complete (see $dir/resume.log)"; continue
+      fi
+      if ! grep -q "Elastic resume" "$dir/resume.log"; then
+        fail "$fault" "resume log does not show the reshard restore"; continue
+      fi
+      row="$dir/results/result_fsdp_ws2_seq32_tierS.json"
+      if [ ! -f "$row" ]; then fail "$fault" "no tp1 result row after resume"; continue; fi
+      if ! python - "$row" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["resumed"] is True, f"resumed={r['resumed']}"
+assert r["resume_geometry_changed"] is True, "tp-change stitch not recorded"
+assert r["tensor_parallel"] == 1, f"tensor_parallel={r['tensor_parallel']}"
+EOF
+      then fail "$fault" "tp-resharded row missing honest accounting"; continue; fi
+      if ! validate "$dir"; then
+        fail "$fault" "validate_results rejected the tp-change resume (see $dir/validate.log)"
+        continue
+      fi
+      ok "$fault" "tp2 checkpoint resumed under tp1; resume_geometry_changed=true validated"
       ;;
     k8s-coordinator)
       # The k8s path's own chaos arm: the coordinator pod (completion

@@ -55,8 +55,10 @@ d["n_heartbeats"] = int(os.environ.get("N_HEARTBEATS", "0"))
 # Death classification (docs/FAULT_TOLERANCE.md): a preempted pod's LAST
 # heartbeat is the emergency one — it carries reason=preempted plus the
 # emergency checkpoint's metadata (step/loss at the save boundary), which
-# supersedes the older cadenced heartbeat's step. Anything without a
-# reason died uncleanly: a crash, not a preemption.
+# supersedes the older cadenced heartbeat's step. A hang-watchdog abort
+# (exit 76) likewise prints a final reason=hang heartbeat before dying,
+# so hung arms classify as reason=hang beside preempted|crash. Anything
+# without a reason died uncleanly: a crash, not a preemption or a hang.
 d.setdefault("reason", "crash")
 if d.get("emergency_checkpoint_step") is not None:
     d["step"] = d["emergency_checkpoint_step"]

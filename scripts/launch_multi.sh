@@ -45,6 +45,11 @@ HEARTBEAT_SEC="${HEARTBEAT_SEC:-30}"
 CHECKPOINT_DIR="${CHECKPOINT_DIR:-}"
 CHECKPOINT_EVERY="${CHECKPOINT_EVERY:-}"
 CHECKPOINT_ASYNC="${CHECKPOINT_ASYNC:-0}"
+# In-process hang watchdog (faults/watchdog.py): empty = off. When set,
+# it must stay BELOW the liveness probe's grace window (10 x
+# HEARTBEAT_SEC, floor 120s) — enforced below — so the stack-dump abort
+# fires before kubelet's forensics-free kill.
+HANG_TIMEOUT_SEC="${HANG_TIMEOUT_SEC:-}"
 # SIGTERM grace (docs/FAULT_TOLERANCE.md): kubelet preemption sends
 # SIGTERM and waits terminationGracePeriodSeconds before SIGKILL. The
 # preemption handler (train/loop.py) acts at the NEXT sync-window
@@ -88,6 +93,7 @@ while [ $# -gt 0 ]; do
     --checkpoint-dir) CHECKPOINT_DIR="$2"; shift 2 ;;
     --checkpoint-every) CHECKPOINT_EVERY="$2"; shift 2 ;;
     --checkpoint-async) CHECKPOINT_ASYNC=1; shift 1 ;;
+    --hang-timeout-sec) HANG_TIMEOUT_SEC="$2"; shift 2 ;;
     --termination-grace-sec) TERMINATION_GRACE_SEC="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
@@ -114,6 +120,28 @@ if [ -z "$TERMINATION_GRACE_SEC" ]; then
   TERMINATION_GRACE_SEC=$(( HEARTBEAT_SEC * 4 ))
   if [ "$TERMINATION_GRACE_SEC" -lt 120 ] 2>/dev/null; then
     TERMINATION_GRACE_SEC=120
+  fi
+fi
+# Watchdog-vs-probe ordering (scripts/liveness_probe.sh): a HANG_TIMEOUT
+# at or above the probe's grace window would let kubelet's forensics-free
+# kill win the race against the in-process stack-dump abort. Refuse the
+# misconfiguration rather than launch it. The effective grace is an
+# explicit LIVENESS_GRACE_SEC when the operator set one (plumbed into the
+# pod below so the probe actually honors it), else the probe's own
+# derived default (10 x HEARTBEAT_SEC, floor 120).
+LIVENESS_GRACE_SEC="${LIVENESS_GRACE_SEC:-}"
+if [ -n "$HANG_TIMEOUT_SEC" ]; then
+  if [ -n "$LIVENESS_GRACE_SEC" ]; then
+    PROBE_GRACE="$LIVENESS_GRACE_SEC"
+  else
+    PROBE_GRACE=$(( HEARTBEAT_SEC * 10 ))
+    if [ "$PROBE_GRACE" -lt 120 ] 2>/dev/null; then PROBE_GRACE=120; fi
+  fi
+  if [ "${HANG_TIMEOUT_SEC%.*}" -ge "${PROBE_GRACE%.*}" ] 2>/dev/null; then
+    echo "ERROR: --hang-timeout-sec $HANG_TIMEOUT_SEC >= the liveness" \
+         "probe grace (${PROBE_GRACE}s) — the watchdog must fire FIRST;" \
+         "lower the timeout or raise HEARTBEAT_SEC/LIVENESS_GRACE_SEC"
+    exit 1
   fi
 fi
 echo "Launching: job=$JOB_NAME strategy=$STRATEGY world_size=$WORLD_SIZE hosts=$NUM_HOSTS"
@@ -151,6 +179,8 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{CHECKPOINT_DIR}}|$CHECKPOINT_DIR|g" \
     -e "s|{{CHECKPOINT_EVERY}}|$CHECKPOINT_EVERY|g" \
     -e "s|{{CHECKPOINT_ASYNC}}|$CHECKPOINT_ASYNC|g" \
+    -e "s|{{HANG_TIMEOUT_SEC}}|$HANG_TIMEOUT_SEC|g" \
+    -e "s|{{LIVENESS_GRACE_SEC}}|$LIVENESS_GRACE_SEC|g" \
     -e "s|{{LIVENESS_PERIOD}}|$LIVENESS_PERIOD|g" \
     -e "s|{{TERMINATION_GRACE_SEC}}|$TERMINATION_GRACE_SEC|g" \
     -e "s|{{IMAGE}}|$IMAGE|g" \

@@ -30,6 +30,17 @@
 # (TELEMETRY=false) likewise means no signal — the probe stays quiet
 # rather than killing a healthy run.
 #
+# Interplay with the IN-PROCESS hang watchdog (--hang-timeout-sec /
+# HANG_TIMEOUT_SEC, faults/watchdog.py): when the watchdog is armed, its
+# timeout must be STRICTLY BELOW this probe's grace window. The watchdog
+# fires first and leaves forensics — an all-thread stack dump in the
+# telemetry hang_dump event, a coherent all-rank exit 76 the retry loop
+# resumes from; the probe's pod kill leaves a bare 137. With the default
+# grace (10 x HEARTBEAT_SEC, floor 120s), any HANG_TIMEOUT_SEC under
+# 2 minutes keeps the watchdog ahead; operators raising HANG_TIMEOUT_SEC
+# past the grace must raise LIVENESS_GRACE_SEC with it, or the probe
+# races the watchdog and wins with the uninformative kill.
+#
 # Exit 0 = alive, 1 = stalled (kubelet restarts the container). Pinned by
 # tests/test_regress.py (fresh/stale/absent/torn cases, both channels).
 set -euo pipefail

@@ -96,9 +96,11 @@ SKIP_REGRESS="${SKIP_REGRESS:-0}"
 # Chaos smoke (scripts/chaos_suite.sh --smoke, docs/FAULT_TOLERANCE.md):
 # before burning slice time on the matrix, prove in ~a minute on the host
 # CPU that the recovery machinery works — a SIGKILL'd arm resumes from
-# its checkpoint and a torn checkpoint quarantines + falls back. Runs in
-# a throwaway tmpdir so its artifacts never pollute RESULTS_DIR, the
-# registry, or the report. SKIP_CHAOS=1 bypasses (same escape hatch as
+# its checkpoint, a torn checkpoint quarantines + falls back, and a
+# bitflip-poisoned arm is healed in-process by the numerics sentinel
+# (rollback + replay, n_rollbacks=1, validated). Runs in a throwaway
+# tmpdir so its artifacts never pollute RESULTS_DIR, the registry, or
+# the report. SKIP_CHAOS=1 bypasses (same escape hatch as
 # SKIP_PREFLIGHT/SKIP_REGRESS); dry runs plan only and skip it too.
 SKIP_CHAOS="${SKIP_CHAOS:-0}"
 # Retrying orchestration (scripts/with_retries.sh): each local arm gets
@@ -182,7 +184,7 @@ if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_PREFLIGHT" != "1" ]; then
 fi
 
 if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_CHAOS" != "1" ]; then
-  echo "=== Chaos smoke: recovery proof (sigkill + torn-checkpoint + elastic) ==="
+  echo "=== Chaos smoke: recovery proof (sigkill + torn-checkpoint + bitflip-heal + elastic) ==="
   CHAOS_DIR=$(mktemp -d /tmp/chaos_smoke.XXXXXX)
   # --elastic: the geometry-change resume proof (save@dp4 -> resume@dp2 ->
   # validate_results passes with resume_geometry_changed=true) rides the

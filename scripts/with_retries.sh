@@ -36,9 +36,14 @@ set -uo pipefail
 MAX_ARM_RETRIES="${MAX_ARM_RETRIES:-1}"
 RETRY_BACKOFF_SEC="${RETRY_BACKOFF_SEC:-5}"
 EXIT_PREEMPTED=75
+# Hang watchdog abort (faults/watchdog.py): the run wedged, dumped its
+# stacks and exited — the checkpoints on disk are intact, so this is
+# retryable-with-resume exactly like a preemption.
+EXIT_HUNG=76
 # Deterministic refusal (harness: resume found no steps left to run) —
-# never retried; every attempt would refuse identically.
-EXIT_NOTHING_TO_RESUME=76
+# never retried; every attempt would refuse identically. (Renumbered
+# 76 -> 77 in the self-healing round; 76 is now EXIT_HUNG above.)
+EXIT_NOTHING_TO_RESUME=77
 
 RESUME_FLAG=""
 DROP_ON_RETRY=""
@@ -104,6 +109,7 @@ while :; do
   fi
   kind="exit=$rc"
   [ "$rc" -eq "$EXIT_PREEMPTED" ] && kind="preempted (exit=$rc)"
+  [ "$rc" -eq "$EXIT_HUNG" ] && kind="hung (exit=$rc, watchdog abort)"
   backoff=$((RETRY_BACKOFF_SEC * (1 << (attempt - 1))))
   echo "with_retries: attempt $attempt failed [$kind]; retrying" \
        "${RESUME_FLAG:+with $RESUME_FLAG }in ${backoff}s" \

@@ -479,7 +479,7 @@ def test_sigterm_during_final_window_publishes_instead_of_aborting(
 ):
     """A preemption with every step already executed must publish: the
     alternative is exit 75 promising a resume that deterministically
-    refuses (exit 76), losing a 100%-complete measurement."""
+    refuses (exit 77), losing a 100%-complete measurement."""
     base = tmp_path_factory.mktemp("sigterm_final")
     p = _run_harness(base / "results", base / "ckpt",
                      ("--inject-fault", "sigterm@13"))  # fires at the
@@ -582,14 +582,19 @@ def test_partial_reason_flows_into_metrics_and_report(tmp_path):
     json.dump(dict(base, arm="b", strategy="fsdp", reason="crash",
                    n_heartbeats=2),
               open(rdir / "partial_b.json", "w"))
+    json.dump(dict(base, arm="c", strategy="zero2", reason="hang",
+                   n_heartbeats=4),
+              open(rdir / "partial_c.json", "w"))
     df = parse_metrics.load_results(str(rdir))
-    assert sorted(df["reason"]) == ["crash", "preempted"]
+    assert sorted(df["reason"]) == ["crash", "hang", "preempted"]
     csv = tmp_path / "metrics.csv"
     df.to_csv(csv, index=False)
     out = tmp_path / "summary"
     make_report.main(["--csv", str(csv), "--out", str(out)])
     report = open(out / "BENCHMARK_REPORT.md").read()
-    assert "1 preempted with an emergency checkpoint, 1 crashed" in report
+    assert ("1 preempted with an emergency checkpoint, 1 hung "
+            "(watchdog abort, stack dump in telemetry), 1 crashed"
+            in report)
 
 
 # ---------------------------------------------------------------------------

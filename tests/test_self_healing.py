@@ -1,0 +1,700 @@
+"""Self-healing loop proofs: hang watchdog + numerics sentinel.
+
+Covers the self-healing round end to end (docs/FAULT_TOLERANCE.md):
+
+- fault-spec grammar for the new kinds (bitflip@N, grad-explode@N,
+  stall-rank@N:R[:SECS]);
+- watchdog units (beat/deadline/stack dump/exit-fn injection) without
+  ever letting os._exit near the test process;
+- sentinel guard units (NaN, loss envelope both directions, grad-norm
+  explosion, parameter-checksum SDC) and the rollback ledger;
+- REAL-subprocess proofs: ``hang@N`` with a short ``--hang-timeout-sec``
+  exits EXIT_HUNG (76) with a ``hang_dump`` stack-dump event in the
+  JSONL and a reason=hang final heartbeat the collect script classifies;
+  ``bitflip@N`` completes IN PROCESS with ``n_rollbacks=1`` and passes
+  validate_results; ``grad-explode@N`` heals via the loss-envelope trip
+  (in-process run_benchmark — no signals involved);
+- rolled-back records join resumed/partial rows in the regress
+  never-baseline set, and the gate SKIPs them;
+- ``regress bisect`` finds the first-bad git-sha boundary;
+- validator coherence for the rollback ledger;
+- wiring pins: exit-code renumbering, chaos_suite arms, with_retries
+  retry-on-76, the suite smoke gaining bitflip, entrypoint env plumbing,
+  and the liveness-probe grace-vs-watchdog documentation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distributed_llm_training_benchmark_framework_tpu import faults
+from distributed_llm_training_benchmark_framework_tpu.faults import (
+    sentinel as sentinel_mod,
+)
+from distributed_llm_training_benchmark_framework_tpu.faults.watchdog import (
+    EXIT_HUNG,
+    HangWatchdog,
+    format_all_stacks,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARM = "ddp_ws1_seq32_tierS"
+
+HARNESS = [
+    sys.executable, "-u",
+    os.path.join(REPO, "benchmarking", "train_harness.py"),
+    "--strategy", "ddp", "--world-size", "1", "--rank", "0",
+    "--tier", "S", "--seq-len", "32", "--steps", "14",
+    "--warmup-steps", "2", "--per-device-batch", "1", "--grad-accum", "1",
+    "--dataset-size", "64", "--heartbeat-sec", "0", "--sync-every", "2",
+]
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("INJECT_FAULT", None)
+    return env
+
+
+def _run_harness(results_dir, ckpt_dir, extra=(), timeout=240):
+    return subprocess.run(
+        HARNESS + [
+            "--results-dir", str(results_dir),
+            "--checkpoint-dir", str(ckpt_dir), "--checkpoint-every", "4",
+            *extra,
+        ],
+        capture_output=True, text=True, env=_env(), timeout=timeout,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fault-spec grammar: the new kinds
+# ---------------------------------------------------------------------------
+
+
+def test_new_fault_spec_grammar():
+    s = faults.parse_fault_spec("bitflip@7")
+    assert (s.kind, s.step, s.rank, s.hang_sec) == ("bitflip", 7, None, None)
+    s = faults.parse_fault_spec("grad-explode@3")
+    assert (s.kind, s.step) == ("grad-explode", 3)
+    s = faults.parse_fault_spec("stall-rank@6:1:600")
+    assert (s.kind, s.step, s.rank, s.hang_sec) == ("stall-rank", 6, 1, 600.0)
+    assert str(s) == "stall-rank@6:1:600"
+    s = faults.parse_fault_spec("stall-rank@6:2")
+    assert (s.rank, s.hang_sec) == (2, None)
+
+
+@pytest.mark.parametrize("bad", [
+    "bitflip",              # stepped kind needs @N
+    "bitflip@2:1",          # no suffix on unranked kinds
+    "grad-explode@2:5",     # same
+    "stall-rank@4",         # ranked kind needs :R
+    "stall-rank@4:x",       # rank must be an int
+    "stall-rank@4:1:0",     # stall duration must be > 0
+    "stall-rank@4:1:abc",   # duration must be a number
+])
+def test_new_fault_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec(bad)
+
+
+def test_fault_kinds_registry_covers_new_kinds():
+    for kind in ("bitflip", "grad-explode", "stall-rank"):
+        assert kind in faults.FAULT_KINDS
+
+
+# ---------------------------------------------------------------------------
+# Exit-code contract
+# ---------------------------------------------------------------------------
+
+
+def test_exit_codes_distinct_and_renumbered():
+    # EXIT_HUNG took 76 (retryable-with-resume); the never-retry
+    # NothingToResume refusal moved to 77 — the two semantics must never
+    # share a code, and neither may collide with EXIT_PREEMPTED.
+    assert faults.EXIT_HUNG == 76
+    assert faults.EXIT_NOTHING_TO_RESUME == 77
+    assert len({faults.EXIT_HUNG, faults.EXIT_NOTHING_TO_RESUME,
+                faults.EXIT_PREEMPTED}) == 3
+
+
+# ---------------------------------------------------------------------------
+# Watchdog units (exit fn injected — os._exit never runs in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_disarmed_by_default():
+    wd = HangWatchdog(0.0)
+    assert not wd.armed
+    wd.start()
+    assert wd._thread is None
+    wd.disarm()
+
+
+def test_watchdog_does_not_fire_before_first_beat():
+    fired = []
+    wd = HangWatchdog(0.05, poll_interval_sec=0.01, _exit=fired.append)
+    wd.start()
+    time.sleep(0.2)  # no beat ever: deadline must stay unarmed
+    wd.disarm()
+    assert fired == []
+
+
+def test_watchdog_fires_on_stalled_beats_and_dumps():
+    fired = []
+    dumped = []
+
+    class Rec:
+        def note(self, event, **fields):
+            dumped.append((event, fields))
+
+        def emergency_heartbeat(self, **kw):
+            dumped.append(("heartbeat", kw))
+
+        def abort(self, reason):
+            dumped.append(("abort", {"reason": reason}))
+
+    wd = HangWatchdog(0.05, recorder=Rec(), poll_interval_sec=0.01,
+                      _exit=fired.append)
+    wd.beat(7)
+    wd.start()
+    deadline = time.time() + 5
+    while not fired and time.time() < deadline:
+        time.sleep(0.01)
+    wd.disarm()
+    assert fired == [EXIT_HUNG]
+    events = dict((e, f) for e, f in dumped)
+    assert "hang_dump" in events
+    dump = events["hang_dump"]
+    assert dump["last_beat_step"] == 7
+    assert dump["stacks"] and any("Thread" in s for s in dump["stacks"])
+    assert events["heartbeat"]["reason"] == "hang"
+    assert events["abort"]["reason"] == "hang"
+
+
+def test_watchdog_beats_keep_it_quiet():
+    fired = []
+    wd = HangWatchdog(0.2, poll_interval_sec=0.02, _exit=fired.append)
+    wd.beat(0)
+    wd.start()
+    for i in range(10):
+        time.sleep(0.05)
+        wd.beat(i)
+    wd.disarm()
+    assert fired == []
+
+
+def test_format_all_stacks_includes_this_frame():
+    def distinctive_frame_name_for_stack_dump():
+        return format_all_stacks()
+
+    stacks = distinctive_frame_name_for_stack_dump()
+    joined = "\n".join(stacks)
+    assert "distinctive_frame_name_for_stack_dump" in joined
+    # One entry per live thread, at least the main thread.
+    assert len(stacks) >= 1
+    assert any(t.name == "MainThread" for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# Sentinel guard units
+# ---------------------------------------------------------------------------
+
+
+def _warm(s, n=6, loss=5.5, gnorm=1.0):
+    for i in range(n):
+        assert s.observe(i, loss, gnorm) is None
+
+
+def test_sentinel_trips_on_nan_loss():
+    s = sentinel_mod.NumericsSentinel()
+    _warm(s)
+    trip = s.observe(6, float("nan"))
+    assert trip and trip["kind"] == "nan_loss" and trip["step"] == 6
+    # Open trip: further observations are no-ops (one event per incident).
+    assert s.observe(7, float("nan")) is None
+    assert s.n_trips == 1
+
+
+def test_sentinel_trips_on_loss_spike_and_collapse():
+    s = sentinel_mod.NumericsSentinel()
+    _warm(s)
+    trip = s.observe(6, 50.0)
+    assert trip and trip["kind"] == "loss_spike"
+    s2 = sentinel_mod.NumericsSentinel()
+    _warm(s2)
+    trip = s2.observe(6, 0.01)
+    assert trip and trip["kind"] == "loss_collapse"
+
+
+def test_sentinel_ordinary_descent_never_trips():
+    s = sentinel_mod.NumericsSentinel()
+    # A realistic fast early descent: whole-run 5.6 -> 1.0, per-step
+    # deltas far inside the envelope.
+    loss = 5.6
+    for i in range(100):
+        assert s.observe(i, loss, 1.0 + 0.01 * (i % 7)) is None
+        loss = max(1.0, loss - 0.05)
+    assert s.n_trips == 0
+
+
+def test_sentinel_trips_on_grad_norm_explosion_and_nonfinite():
+    s = sentinel_mod.NumericsSentinel()
+    _warm(s)
+    trip = s.observe(6, 5.5, 1.0 * sentinel_mod.GRAD_SPIKE_FACTOR * 2)
+    assert trip and trip["kind"] == "grad_explode"
+    s2 = sentinel_mod.NumericsSentinel()
+    _warm(s2)
+    trip = s2.observe(6, 5.5, float("inf"))
+    assert trip and trip["kind"] == "grad_explode"
+
+
+def test_sentinel_param_checksum_sdc():
+    s = sentinel_mod.NumericsSentinel()
+    assert s.observe_param_checksum(4, 28.7) is None   # baseline
+    assert s.observe_param_checksum(8, 28.9) is None   # ordinary drift
+    trip = s.observe_param_checksum(12, 7242.0)
+    assert trip and trip["kind"] == "sdc"
+    s2 = sentinel_mod.NumericsSentinel()
+    assert s2.observe_param_checksum(4, 28.7) is None
+    trip = s2.observe_param_checksum(8, float("inf"))
+    assert trip and trip["kind"] == "sdc"
+
+
+def test_sentinel_rollback_ledger_and_bound():
+    s = sentinel_mod.NumericsSentinel(max_rollbacks=2)
+    _warm(s)
+    s.observe(6, float("nan"))
+    assert s.rollback_allowed
+    s.note_rollback(from_step=6, to_step=4)
+    assert s.trip is None
+    assert (s.n_rollbacks, s.rollback_steps_replayed, s.data_reseeds) == (1, 2, 1)
+    s.observe(8, float("nan"))
+    s.note_rollback(from_step=8, to_step=4)
+    assert s.n_rollbacks == 2 and s.rollback_steps_replayed == 6
+    assert not s.rollback_allowed
+    # The checksum baseline resets across a rollback: restored (older)
+    # params must not themselves read as an SDC jump.
+    assert s._last_pnorm is None
+
+
+# ---------------------------------------------------------------------------
+# Real-subprocess proofs
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def hang_round_trip(tmp_path_factory):
+    base = tmp_path_factory.mktemp("hang_watchdog")
+    p = _run_harness(
+        base / "results", base / "ckpt",
+        ("--hang-timeout-sec", "5", "--inject-fault", "hang@6:600"),
+    )
+    return base, p
+
+
+def test_hang_exits_76_with_stack_dump(hang_round_trip):
+    base, p = hang_round_trip
+    assert p.returncode == EXIT_HUNG, p.stdout[-3000:] + p.stderr[-3000:]
+    assert "HANG WATCHDOG" in p.stderr
+    events = [json.loads(l) for l in
+              open(base / "results" / f"telemetry_{ARM}.jsonl")]
+    dumps = [e for e in events if e["event"] == "hang_dump"]
+    assert len(dumps) == 1
+    assert dumps[0]["stacks"], "hang_dump must carry the thread stacks"
+    # The stall is inside the injector's sleep at a sync boundary — the
+    # dump must show it (time.sleep in faults/injection.py).
+    assert any("time.sleep" in s for s in dumps[0]["stacks"])
+    aborts = [e for e in events if e["event"] == "run_aborted"]
+    assert aborts and aborts[-1]["reason"] == "hang"
+
+
+def test_hang_final_heartbeat_and_collect_classify_hang(
+    hang_round_trip, tmp_path,
+):
+    base, p = hang_round_trip
+    from distributed_llm_training_benchmark_framework_tpu.telemetry import (
+        parse_heartbeat_line,
+    )
+
+    hbs = [parse_heartbeat_line(l) for l in p.stdout.splitlines()
+           if parse_heartbeat_line(l)]
+    assert hbs and hbs[-1]["reason"] == "hang"
+    log = tmp_path / "run.log"
+    log.write_text(p.stdout)
+    out = tmp_path / "salvage"
+    r = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "collect_results.sh"),
+         "--log", str(log), str(out)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    partial = json.load(open(out / f"partial_{ARM}.json"))
+    assert partial["reason"] == "hang"
+
+
+def test_hang_resume_completes_validated(hang_round_trip):
+    base, p = hang_round_trip
+    p2 = _run_harness(base / "results", base / "ckpt", ("--resume",))
+    assert p2.returncode == 0, p2.stdout[-3000:] + p2.stderr[-2000:]
+    row = json.load(open(base / "results" / f"result_{ARM}.json"))
+    assert row["resumed"] is True and row["n_restarts"] >= 1
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results,
+    )
+
+    failures, n = validate_results.collect(str(base / "results"), None)
+    assert n >= 1 and failures == [], failures
+
+
+@pytest.fixture(scope="module")
+def bitflip_round_trip(tmp_path_factory):
+    base = tmp_path_factory.mktemp("bitflip_heal")
+    p = _run_harness(
+        base / "results", base / "ckpt",
+        ("--sentinel", "on", "--sentinel-checksum-every", "4",
+         "--inject-fault", "bitflip@9"),
+    )
+    return base, p
+
+
+def test_bitflip_heals_in_process(bitflip_round_trip):
+    base, p = bitflip_round_trip
+    assert p.returncode == 0, p.stdout[-3000:] + p.stderr[-2000:]
+    row = json.load(open(base / "results" / f"result_{ARM}.json"))
+    assert row["n_rollbacks"] == 1
+    assert row["rollback_steps_replayed"] >= 1
+    assert row["resumed"] is False, "a heal is not a restart"
+    events = [json.loads(l) for l in
+              open(base / "results" / f"telemetry_{ARM}.jsonl")]
+    kinds = [e["kind"] for e in events if e["event"] == "sentinel_trip"]
+    assert kinds == ["sdc"], kinds
+    rbs = [e for e in events if e["event"] == "rollback"]
+    assert len(rbs) == 1 and rbs[0]["steps_replayed"] >= 1
+    assert rbs[0]["data_reseeds"] == 1
+
+
+def test_bitflip_passes_validate_results(bitflip_round_trip):
+    base, _p = bitflip_round_trip
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results,
+    )
+
+    failures, n = validate_results.collect(str(base / "results"), None)
+    assert n >= 1 and failures == [], failures
+
+
+def test_bitflip_never_checkpoints_the_poison(bitflip_round_trip):
+    # The save-skip guard: no committed step may fail its own digest, and
+    # the trip's boundary must have skipped its save (the log says so).
+    base, p = bitflip_round_trip
+    assert "skipping checkpoint save" in p.stdout
+
+
+def test_grad_explode_heals_via_loss_envelope(tmp_path):
+    # In-process (no signals involved): the weight-tied embedding scale
+    # saturates the logits onto the gold token, the loss collapses, the
+    # two-sided envelope trips at the very next boundary, and the run
+    # heals with one rollback.
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import (
+        run_benchmark,
+    )
+
+    result = run_benchmark(
+        strategy=get_strategy("ddp"), tier="S", seq_len=32, steps=14,
+        warmup_steps=2, per_device_batch=1, grad_accum=1, world_size=1,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=4,
+        sync_every=2, sentinel=True,
+        inject_fault="grad-explode@9", telemetry=True, heartbeat_sec=0,
+    )
+    assert result.n_rollbacks == 1
+    assert result.rollback_steps_replayed >= 1
+    events = [json.loads(l) for l in
+              open(tmp_path / "results" / f"telemetry_{ARM}.jsonl")]
+    kinds = [e["kind"] for e in events if e["event"] == "sentinel_trip"]
+    assert kinds == ["loss_collapse"], kinds
+
+
+def test_sentinel_without_checkpoint_fails_loudly(tmp_path):
+    # No --checkpoint-dir: the sentinel trips but cannot heal — the run
+    # must fail loudly (SentinelTripped), never publish the poisoned row.
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import (
+        run_benchmark,
+    )
+
+    with pytest.raises(faults.SentinelTripped):
+        run_benchmark(
+            strategy=get_strategy("ddp"), tier="S", seq_len=32, steps=14,
+            warmup_steps=2, per_device_batch=1, grad_accum=1, world_size=1,
+            results_dir=str(tmp_path / "results"),
+            sync_every=2, sentinel=True,
+            inject_fault="grad-explode@9", telemetry=True, heartbeat_sec=0,
+        )
+    assert not (tmp_path / "results" / f"result_{ARM}.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# Regress: rolled-back records are never baselines; bisect
+# ---------------------------------------------------------------------------
+
+
+def _record(reg_mod, arm, tps, *, n_rollbacks=0, sha=None, extra=None):
+    row = {
+        "strategy": "ddp", "world_size": 1, "seq_len": 32, "tier": "S",
+        "tokens_per_sec": tps, "mean_step_time_sec": 0.01,
+        "mean_loss": 5.0, "peak_vram_gb": 1.0, "h2d_gbps_per_gpu": 0.1,
+        "n_rollbacks": n_rollbacks,
+        "rollback_steps_replayed": 4 if n_rollbacks else 0,
+    }
+    row.update(extra or {})
+    rec = reg_mod.make_record(arm=arm, result_row=row, status="ok",
+                              source=f"test:{tps}")
+    if sha is not None:
+        rec["env"]["git_sha"] = sha
+    return rec
+
+
+def test_rolled_back_records_never_baseline(tmp_path):
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        store,
+    )
+
+    reg = store.Registry(str(tmp_path / "reg"))
+    clean, _ = reg.ingest(_record(store, "a", 1000.0))
+    healed, _ = reg.ingest(_record(store, "a", 2000.0, n_rollbacks=1))
+    base = reg.baseline("a")
+    assert base["record_id"] == clean["record_id"], \
+        "a rolled-back record must never be the baseline"
+    assert 2000.0 not in reg.history_values("a", metric_name="tokens_per_sec")
+
+
+def test_gate_skips_rolled_back_candidate(tmp_path):
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        compare,
+        store,
+    )
+
+    reg = store.Registry(str(tmp_path / "reg"))
+    reg.ingest(_record(store, "a", 1000.0))
+    reg.ingest(_record(store, "a", 100.0, n_rollbacks=1))  # would regress
+    verdict, line = compare.gate_arm(reg, "a")
+    assert verdict == "insufficient-data"
+    assert "rolled-back (sentinel-healed)" in line
+
+
+def test_trend_flags_healed_records(tmp_path):
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        compare,
+        store,
+    )
+
+    reg = store.Registry(str(tmp_path / "reg"))
+    reg.ingest(_record(store, "a", 1000.0))
+    reg.ingest(_record(store, "a", 990.0, n_rollbacks=1))
+    rows = compare.trend_rows(reg, "a")
+    assert rows[1]["rolled_back"] is True
+    assert "HEALED" in compare.format_trend("a", rows)
+
+
+def test_bisect_finds_first_bad_sha_boundary(tmp_path):
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        compare,
+        store,
+    )
+
+    reg = store.Registry(str(tmp_path / "reg"))
+    good, _ = reg.ingest(_record(store, "a", 1000.0, sha="aaa1"))
+    reg.ingest(_record(store, "a", 1010.0, sha="bbb2"))
+    first_bad, _ = reg.ingest(_record(store, "a", 500.0, sha="ccc3"))
+    bad, _ = reg.ingest(_record(store, "a", 490.0, sha="ddd4"))
+    rep = compare.bisect_records(reg, good, bad)
+    assert rep["first_bad"]["record_id"] == first_bad["record_id"]
+    assert rep["last_good"]["env"]["git_sha"] == "bbb2"
+    text = compare.format_bisect(rep)
+    assert "FIRST BAD" in text and "ccc3" in text and "bbb2" in text
+
+
+def test_bisect_cli_and_ordering_refusal(tmp_path):
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        compare,
+        store,
+    )
+
+    reg = store.Registry(str(tmp_path / "reg"))
+    good, _ = reg.ingest(_record(store, "a", 1000.0, sha="aaa1"))
+    bad, _ = reg.ingest(_record(store, "a", 500.0, sha="bbb2"))
+    rc = compare.main(["--registry", str(tmp_path / "reg"), "bisect",
+                       good["record_id"], bad["record_id"]])
+    assert rc == 0
+    with pytest.raises(KeyError):
+        compare.bisect_records(reg, bad, good)  # wrong ingest order
+
+
+def test_rollback_windows_masked_in_stats():
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        stats,
+    )
+
+    events = [
+        {"event": "step_window", "phase": "timed", "step": s,
+         "window_mean_step_time_sec": 0.01, "steps_in_window": 2,
+         "loss": 5.0}
+        for s in (6, 8, 10, 12)
+    ] + [
+        {"event": "rollback", "from_step": 10, "to_step": 8,
+         "steps_replayed": 2},
+        # The replayed copies of the same windows.
+        {"event": "step_window", "phase": "timed", "step": 10,
+         "window_mean_step_time_sec": 0.02, "steps_in_window": 2,
+         "loss": 5.0},
+    ]
+    kept, masked = stats.split_masked_windows(events)
+    kept_steps = sorted(w["step"] for w in kept)
+    # Steps in (8, 10] — both the poisoned original and the replay — are
+    # masked; everything else survives.
+    assert kept_steps == [6, 8, 12]
+    assert len(masked) == 2
+    assert all(8 < w["step"] <= 10 for w in masked)
+
+
+# ---------------------------------------------------------------------------
+# Validator: rollback-ledger coherence
+# ---------------------------------------------------------------------------
+
+
+def _healed_row(**over):
+    row = {
+        "strategy": "ddp", "world_size": 1, "rank": 0, "seq_len": 32,
+        "tier": "S", "steps": 14, "per_device_batch": 1, "grad_accum": 1,
+        "tokens_per_sec": 900.0, "mean_step_time_sec": 0.01,
+        "mean_loss": 5.0, "peak_vram_gb": 0.1, "h2d_gbps_per_gpu": 0.1,
+        "n_rollbacks": 1, "rollback_steps_replayed": 4,
+    }
+    row.update(over)
+    return row
+
+
+def test_validator_accepts_coherent_rollback_ledger():
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results,
+    )
+
+    assert validate_results.validate_result(_healed_row(), "r") == []
+
+
+def test_validator_rejects_rollbacks_without_replayed_steps():
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results,
+    )
+
+    f = validate_results.validate_result(
+        _healed_row(rollback_steps_replayed=0), "r"
+    )
+    assert any("sentinel ledger is incoherent" in m for m in f)
+
+
+def test_validator_rejects_replayed_steps_without_rollbacks():
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results,
+    )
+
+    f = validate_results.validate_result(
+        _healed_row(n_rollbacks=0, rollback_steps_replayed=3), "r"
+    )
+    assert any("sentinel ledger is incoherent" in m for m in f)
+
+
+# ---------------------------------------------------------------------------
+# Wiring pins (scripts / entrypoint / docs contracts)
+# ---------------------------------------------------------------------------
+
+
+def test_with_retries_treats_76_as_retryable_and_77_terminal():
+    text = open(os.path.join(REPO, "scripts", "with_retries.sh")).read()
+    assert "EXIT_HUNG=76" in text
+    assert "EXIT_NOTHING_TO_RESUME=77" in text
+    # The never-retry branch keys on NOTHING_TO_RESUME only — EXIT_HUNG
+    # must fall through to the retry path.
+    assert '"$EXIT_HUNG"' not in text.split("EXIT_NOTHING_TO_RESUME\"")[0] \
+        or "hung (exit=$rc" in text
+
+
+def test_with_retries_resumes_after_hung_exit(tmp_path):
+    # Stub: first attempt exits 76 (hung), retry must carry --resume and
+    # succeed.
+    stub = tmp_path / "stub.sh"
+    stub.write_text(
+        "#!/usr/bin/env bash\n"
+        f'marker="{tmp_path}/attempted"\n'
+        'if [ ! -f "$marker" ]; then touch "$marker"; exit 76; fi\n'
+        'echo "args: $@"\n'
+        'for a in "$@"; do [ "$a" = "--resume" ] && exit 0; done\n'
+        "exit 9\n"
+    )
+    stub.chmod(0o755)
+    p = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "with_retries.sh"),
+         "--resume-flag", "--resume", "--", str(stub)],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, MAX_ARM_RETRIES="1", RETRY_BACKOFF_SEC="0"),
+    )
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "hung (exit=76" in p.stderr
+
+
+def test_chaos_suite_covers_self_healing_arms():
+    text = open(os.path.join(REPO, "scripts", "chaos_suite.sh")).read()
+    for needle in ("bitflip", "grad-explode", "stall-rank",
+                   "--hang-timeout-sec", "hang_dump", "n_rollbacks",
+                   "elastic-tp", "--tensor-parallel 2"):
+        assert needle in text, f"chaos_suite.sh missing {needle}"
+    # The hang arm must assert the watchdog's 76, not an external kill.
+    assert '-ne 76' in text
+
+
+def test_suite_smoke_includes_bitflip_and_escape_hatch():
+    suite = open(os.path.join(REPO, "scripts",
+                              "run_all_benchmarks.sh")).read()
+    assert "SKIP_CHAOS" in suite and "chaos_suite.sh --smoke" in suite
+    chaos = open(os.path.join(REPO, "scripts", "chaos_suite.sh")).read()
+    assert 'FAULTS="sigkill torn-checkpoint bitflip"' in chaos
+
+
+def test_entrypoint_plumbs_self_healing_knobs():
+    text = open(os.path.join(REPO, "docker", "entrypoint.sh")).read()
+    for needle in ("HANG_TIMEOUT_SEC", "--hang-timeout-sec",
+                   "SENTINEL", "--sentinel",
+                   "SENTINEL_CHECKSUM_EVERY", "--sentinel-checksum-every"):
+        assert needle in text, f"entrypoint.sh missing {needle}"
+
+
+def test_liveness_probe_documents_watchdog_interplay():
+    text = open(os.path.join(REPO, "scripts", "liveness_probe.sh")).read()
+    assert "HANG_TIMEOUT_SEC" in text and "watchdog" in text
+
+
+def test_k8s_template_and_launcher_plumb_hang_timeout():
+    tmpl = open(os.path.join(REPO, "k8s",
+                             "job-benchmark.template.yaml")).read()
+    assert "{{HANG_TIMEOUT_SEC}}" in tmpl
+    launcher = open(os.path.join(REPO, "scripts", "launch_multi.sh")).read()
+    assert "--hang-timeout-sec" in launcher
+    assert "{{HANG_TIMEOUT_SEC}}" in launcher
+    # The launcher refuses a watchdog timeout at/above the probe grace —
+    # the watchdog must always win the race against the pod kill.
+    assert "PROBE_GRACE" in launcher
