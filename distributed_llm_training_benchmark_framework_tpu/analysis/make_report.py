@@ -447,6 +447,9 @@ def build_report(
                     ""]
 
     if registry_root:
+        from .scaling import scaling_section
+
+        out += scaling_section(registry_root)
         out += remat_frontier_section(registry_root)
         out += trend_section(registry_root)
 

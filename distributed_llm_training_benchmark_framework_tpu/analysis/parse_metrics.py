@@ -121,6 +121,11 @@ def load_results(results_dir: str) -> pd.DataFrame:
             "n_experts", "remat_policy", "param_dtype", "offload_opt_state",
             "offload_delayed_update", "offload_dpu_start_step", "causal",
             "ring_zigzag",
+            # Stitched-run identity (scaling suite): a reshard-on-restore
+            # continuation shares every config axis with the fresh point
+            # at the same geometry — without these, one of the two honest
+            # rows silently vanishes from metrics.csv.
+            "resumed", "resume_geometry_changed",
         ) if c in df.columns
     ]
     df = df.drop_duplicates(subset=key, keep="first")
@@ -159,11 +164,28 @@ def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
         eligible = df[~is_partial]
     else:
         eligible = df
+    # Stitched (resumed) and sentinel-healed rows get their efficiency
+    # computed — they are honest rows and the report flags them — but
+    # never serve as a group BASELINE: a restore-folding first window is
+    # not the per-chip ideal everything else should be normalized by
+    # (the same posture the regress registry's _eligible chain takes).
+    ineligible_base = pd.Series(False, index=eligible.index)
+    for col in ("resumed", "resume_geometry_changed"):
+        if col in eligible.columns:
+            ineligible_base |= eligible[col].fillna(False).astype(bool)
+    if "n_rollbacks" in eligible.columns:
+        ineligible_base |= eligible["n_rollbacks"].fillna(0).astype(float) > 0
     # dropna=False: rows from before a schema addition carry NaN in the
     # newer axis columns and must still get their efficiency computed
     # (pandas silently drops NaN-keyed groups by default).
     for _, group in eligible.groupby(group_cols, dropna=False):
-        base = group.loc[group["world_size"].idxmin()]
+        base_pool = group[~ineligible_base.loc[group.index]]
+        if not len(base_pool):
+            # Only stitched/healed rows at this config: no honest ideal
+            # to normalize by — leave their efficiency unmeasured.
+            df.loc[group.index, "scaling_efficiency_pct"] = float("nan")
+            continue
+        base = base_pool.loc[base_pool["world_size"].idxmin()]
         for i in group.index:
             row = df.loc[i]
             denom = base["tokens_per_sec"] * row["world_size"]

@@ -65,6 +65,15 @@ def main(argv=None) -> int:
     p.add_argument("--arms", default=None,
                    help="comma-separated arm subset for --audit "
                         "(default: the whole roster)")
+    p.add_argument("--topology", default=None,
+                   help="comma-separated topology tier(s) "
+                        "(v5e-16|v5e-64|v5e-256): AOT-compile the scalable "
+                        "roster subset against the REAL TPU topology on "
+                        "this CPU host and verdict per-tier budgets + "
+                        "growth laws (docs/STATIC_ANALYSIS.md). --all "
+                        "includes the default tiers "
+                        "(v5e-16,v5e-64) when the host's libtpu can build "
+                        "compile-only clients")
     p.add_argument("--list-arms", action="store_true",
                    help="print the audit roster and exit")
     p.add_argument("--list-rules", action="store_true",
@@ -109,12 +118,35 @@ def main(argv=None) -> int:
             geom = "x".join(map(str, spec.mesh_shape))
             print(f"{spec.name}: {spec.strategy} x {spec.model_family} x "
                   f"mesh {geom} {spec.axes}")
+        for tier in hlo_audit.TOPOLOGY_TIERS.values():
+            print(f"[topology] {tier.name}: {tier.topology_name} "
+                  f"({tier.device_count} devices; arms "
+                  f"{', '.join(hlo_audit.TOPOLOGY_ARMS)})")
         return 0
 
-    do_audit = args.all or args.audit or args.update_budgets
+    topo_tiers = (
+        [t.strip() for t in args.topology.split(",") if t.strip()]
+        if args.topology else []
+    )
+    unknown_tiers = [t for t in topo_tiers if t not in hlo_audit.TOPOLOGY_TIERS]
+    if unknown_tiers:
+        print(f"graftcheck: unknown topology tier(s) {unknown_tiers}; "
+              f"tiers: {list(hlo_audit.TOPOLOGY_TIERS)}", file=sys.stderr)
+        return 2
+
+    # --topology alone runs only the topology audit; --update-budgets
+    # beside it freezes those tiers and NEVER the CPU arm roster — the
+    # roster only regenerates when --update-budgets is given with no
+    # --topology (or the roster audit is explicitly requested via
+    # --all/--audit), so adding a read-only flag like --lint to a
+    # topology freeze cannot silently churn the arm budgets.
+    # write_budgets carries the other section through untouched.
+    do_audit = (args.all or args.audit
+                or (args.update_budgets and not topo_tiers))
     do_lint = args.all or args.lint
-    if not (do_audit or do_lint):
-        p.error("nothing to do: pass --all, --audit, --lint or "
+    do_topology = bool(topo_tiers) or args.all
+    if not (do_audit or do_lint or do_topology):
+        p.error("nothing to do: pass --all, --audit, --lint, --topology or "
                 "--update-budgets")
 
     failures = 0
@@ -201,6 +233,110 @@ def main(argv=None) -> int:
                 f"{len(deltas)} budget delta(s)", file=sys.stderr,
             )
             failures += len(deltas)
+
+    if do_topology:
+        budgets_path = args.budgets or hlo_audit.DEFAULT_BUDGETS_PATH
+        tiers = topo_tiers or list(hlo_audit.TOPOLOGY_DEFAULT_TIERS)
+        fresh = {}
+        try:
+            for tier_name in tiers:
+                tier = hlo_audit.TOPOLOGY_TIERS[tier_name]
+                print(f"graftcheck topology: compiling "
+                      f"{len(hlo_audit.TOPOLOGY_ARMS)} arm(s) against "
+                      f"{tier_name} ({tier.topology_name}, "
+                      f"{tier.device_count} devices) ...", file=sys.stderr)
+                fresh[tier_name] = hlo_audit.audit_topology_tier(
+                    tier, inject=args.inject
+                )
+        except hlo_audit.TopologyUnavailable as e:
+            if topo_tiers:
+                # Explicitly requested: the answer must be loud.
+                print(f"graftcheck topology: {e}", file=sys.stderr)
+                return 2
+            # --all degrades to a visible skip — but findings already
+            # computed for earlier tiers must not be discarded with it.
+            unaudited = [t for t in tiers if t not in fresh]
+            print(f"graftcheck topology: tier(s) {unaudited} SKIPPED "
+                  f"under --all ({e})", file=sys.stderr)
+        except Exception as e:
+            print(f"graftcheck topology: arm failed to compile: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+
+        if fresh:
+            if args.json:
+                import json as _json
+
+                print(_json.dumps(
+                    {t: {r.arm: r.to_budget_entry() for r in reps}
+                     for t, reps in fresh.items()},
+                    indent=2, sort_keys=True,
+                ))
+            if args.update_budgets and topo_tiers:
+                doc = hlo_audit.write_topology_budgets(fresh, budgets_path)
+                print(f"graftcheck topology: froze {len(fresh)} tier "
+                      f"budget(s) into {budgets_path}", file=sys.stderr)
+                growth_doc, _stale = hlo_audit.commensurable_topology_tiers(
+                    doc, fresh_tiers=tuple(fresh)
+                )
+                growth = hlo_audit.growth_law_findings(
+                    hlo_audit.assemble_per_tier(growth_doc)
+                )
+                for g in growth:
+                    print(f"graftcheck topology: WARNING (frozen anyway): "
+                          f"{g}", file=sys.stderr)
+            else:
+                budgets = (
+                    hlo_audit.load_budgets(budgets_path)
+                    if os.path.exists(budgets_path) else {}
+                )
+                import jax
+
+                deltas = []
+                for tier_name, reports in fresh.items():
+                    frozen_on = budgets.get("topology_tiers", {}).get(
+                        tier_name, {}
+                    ).get("jax_version")
+                    if frozen_on is not None and frozen_on != jax.__version__:
+                        print(
+                            f"graftcheck topology: {tier_name} budgets "
+                            f"frozen on jax {frozen_on} but running jax "
+                            f"{jax.__version__} — regenerate with "
+                            f"--topology {tier_name} --update-budgets",
+                            file=sys.stderr,
+                        )
+                        return 2
+                    deltas.extend(hlo_audit.diff_topology_against_budget(
+                        tier_name, reports, budgets
+                    ))
+                # Growth laws judge the fresh reports overlaid on every
+                # OTHER tier's frozen structure, so a one-tier audit still
+                # sees the cross-tier shape — but only tiers frozen on
+                # THIS jax are commensurable with the fresh counts.
+                growth_budgets, stale_tiers = (
+                    hlo_audit.commensurable_topology_tiers(
+                        budgets, fresh_tiers=tuple(fresh),
+                        jax_version=jax.__version__,
+                    )
+                )
+                if stale_tiers:
+                    print(
+                        "graftcheck topology: growth laws exclude "
+                        f"tier(s) {stale_tiers} frozen on a different "
+                        "jax — regenerate them with --topology "
+                        f"{','.join(stale_tiers)} --update-budgets",
+                        file=sys.stderr,
+                    )
+                deltas.extend(hlo_audit.growth_law_findings(
+                    hlo_audit.assemble_per_tier(growth_budgets, fresh)
+                ))
+                for d in deltas:
+                    print(f"graftcheck topology: {d}", file=sys.stderr)
+                print(
+                    f"graftcheck topology: {len(fresh)} tier(s), "
+                    f"{len(deltas)} finding(s)", file=sys.stderr,
+                )
+                failures += len(deltas)
 
     return 1 if failures else 0
 

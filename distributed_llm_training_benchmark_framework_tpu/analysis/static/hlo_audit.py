@@ -344,6 +344,332 @@ def audit_arm(spec: ArmSpec, devices=None) -> ArmReport:
 
 
 # ---------------------------------------------------------------------------
+# Topology tiers: AOT audits of pod-scale meshes on the CPU host
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyTier:
+    """One auditable TPU topology the host compiles AGAINST, not ON.
+
+    ``jax.experimental.topologies.get_topology_desc`` builds a
+    compile-only PJRT client from libtpu's topology tables — no chips,
+    no runtime — so a 1-core CPU host can lower the REAL train step for
+    a v5e-256 mesh and read its collective schedule off the compiled
+    module. The wall clock of such a run is unknowable here; its
+    *structure* (collective counts, reshard suspects, donation) is
+    exact, and that is what the per-tier budgets and growth laws pin.
+    """
+
+    name: str
+    topology_name: str  # libtpu topology string, e.g. "v5e:8x8"
+    device_count: int
+    accelerator_type: str  # silences libtpu's metadata-probe warnings
+
+
+TOPOLOGY_TIERS: Dict[str, TopologyTier] = {
+    t.name: t
+    for t in (
+        TopologyTier("v5e-16", "v5e:4x4", 16, "v5litepod-16"),
+        TopologyTier("v5e-64", "v5e:8x8", 64, "v5litepod-64"),
+        TopologyTier("v5e-256", "v5e:16x16", 256, "v5litepod-256"),
+    )
+}
+
+#: Roster arms audited per tier — the scalable subset: each scales its
+#: 'data' axis (and global batch with it) to fill the tier's device
+#: count, so the growth laws below have one well-defined growing axis.
+TOPOLOGY_ARMS = ("zero2-dp8", "fsdp-dp8", "llama-tp2-gqa")
+
+#: Tiers ``graftcheck --all`` audits by default. v5e-256 compiles in
+#: ~40s+ per arm on a small host — audit it explicitly with
+#: ``--topology v5e-256`` (its budgets are frozen like the others).
+TOPOLOGY_DEFAULT_TIERS = ("v5e-16", "v5e-64")
+
+
+class TopologyUnavailable(RuntimeError):
+    """libtpu topology tables are not loadable on this host."""
+
+
+def _topology_env() -> None:
+    """Compile-only client env, BEFORE libtpu first loads.
+
+    Without ``TPU_SKIP_MDS_QUERY`` libtpu retries the GCE metadata
+    server for minutes on any non-GCP host; the worker vars silence the
+    single-host init warnings. All setdefault — a real TPU VM's env wins.
+    """
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
+    os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    os.environ.setdefault("TPU_WORKER_ID", "0")
+    # Compile-only clients hold no chips, but libtpu still takes the
+    # host-wide lockfile on load; without this a test process auditing a
+    # topology would block the CLI subprocess it spawns (and vice versa).
+    os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "1")
+
+
+#: Set once we claim TPU_ACCELERATOR_TYPE: a real TPU VM's own value is
+#: never overwritten, but OUR per-tier value must not stick across tiers
+#: (setdefault alone would pin the first tier's type on every later one).
+_ACCEL_ENV_OWNED = "_GRAFTCHECK_OWNS_TPU_ACCELERATOR_TYPE"
+
+
+def topology_devices(tier: TopologyTier):
+    """The tier's compile-only device list (raises TopologyUnavailable)."""
+    _topology_env()
+    if (
+        os.environ.get(_ACCEL_ENV_OWNED)
+        or "TPU_ACCELERATOR_TYPE" not in os.environ
+    ):
+        os.environ["TPU_ACCELERATOR_TYPE"] = tier.accelerator_type
+        os.environ[_ACCEL_ENV_OWNED] = "1"
+    try:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name=tier.topology_name
+        )
+        devices = list(topo.devices)
+    except Exception as e:
+        raise TopologyUnavailable(
+            f"cannot build a compile-only client for {tier.name} "
+            f"({tier.topology_name}): {type(e).__name__}: {e} — topology "
+            "AOT audits need a libtpu with topology tables (the benchmark "
+            "image has one; plain CPU wheels may not)"
+        )
+    if len(devices) != tier.device_count:
+        raise TopologyUnavailable(
+            f"topology {tier.topology_name} yielded {len(devices)} devices, "
+            f"expected {tier.device_count}"
+        )
+    return devices
+
+
+def topology_available() -> bool:
+    """Cheap availability probe (the description is table lookup only)."""
+    try:
+        topology_devices(TOPOLOGY_TIERS["v5e-16"])
+        return True
+    except TopologyUnavailable:
+        return False
+
+
+def scale_spec_to_devices(spec: ArmSpec, n_devices: int) -> ArmSpec:
+    """The roster arm at a tier's device count: only 'data' grows.
+
+    The non-data axes (tp/sp/pp/ep degree) are the arm's identity; the
+    data axis absorbs the tier, and the global batch scales with it so
+    per-replica work is constant (weak-scaling shape — the same shape
+    the scaling suite sweeps). Refuses non-divisible tiers loudly.
+    """
+    if "data" not in spec.axes:
+        raise ValueError(f"arm {spec.name!r} has no 'data' axis to scale")
+    di = spec.axes.index("data")
+    other = 1
+    for i, d in enumerate(spec.mesh_shape):
+        if i != di:
+            other *= d
+    if n_devices % other:
+        raise ValueError(
+            f"arm {spec.name!r}: non-data axes fill {other} devices, which "
+            f"does not divide the tier's {n_devices}"
+        )
+    new_data = n_devices // other
+    old_data = spec.mesh_shape[di]
+    if new_data % old_data and old_data % new_data:
+        raise ValueError(
+            f"arm {spec.name!r}: data axis {old_data} does not scale "
+            f"evenly to {new_data}"
+        )
+    shape = list(spec.mesh_shape)
+    shape[di] = new_data
+    return dataclasses.replace(
+        spec,
+        mesh_shape=tuple(shape),
+        global_batch=max(spec.global_batch * new_data // old_data, 1),
+    )
+
+
+def audit_topology_tier(
+    tier: TopologyTier,
+    arm_names: Optional[Tuple[str, ...]] = None,
+    inject: Optional[str] = None,
+) -> List[ArmReport]:
+    """Audit the scalable roster subset against one tier's real topology."""
+    devices = topology_devices(tier)
+    reports: List[ArmReport] = []
+    for name in arm_names or TOPOLOGY_ARMS:
+        spec = ROSTER[name]
+        scaled = scale_spec_to_devices(spec, tier.device_count)
+        if inject:
+            scaled = dataclasses.replace(scaled, inject=inject)
+        reports.append(audit_arm(scaled, devices=devices))
+    return reports
+
+
+def write_topology_budgets(
+    tier_reports: Dict[str, List[ArmReport]],
+    path: str = DEFAULT_BUDGETS_PATH,
+) -> Dict[str, Any]:
+    """Freeze per-tier budgets into the ``topology_tiers`` section.
+
+    Merges over the existing file: regenerating one tier never drops
+    another tier's (or the CPU roster's) budgets, and the serialization
+    stays deterministic so diffs always mean a schedule change.
+    """
+    import jax
+
+    doc = load_budgets(path) if os.path.exists(path) else {"arms": {}}
+    topo = dict(doc.get("topology_tiers", {}))
+    for tier_name, reports in tier_reports.items():
+        tier = TOPOLOGY_TIERS[tier_name]
+        topo[tier_name] = {
+            "device_count": tier.device_count,
+            "topology_name": tier.topology_name,
+            "jax_version": jax.__version__,
+            "arms": {rep.arm: rep.to_budget_entry() for rep in reports},
+        }
+    doc["topology_tiers"] = topo
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def diff_topology_against_budget(
+    tier_name: str, reports: List[ArmReport], budgets: Dict[str, Any],
+) -> List[str]:
+    """Per-tier exact-pin diffs, mirroring :func:`diff_against_budget`."""
+    tier_budget = budgets.get("topology_tiers", {}).get(tier_name)
+    if tier_budget is None:
+        return [
+            f"{tier_name}: no frozen topology budgets for this tier "
+            "(run --topology " + tier_name + " --update-budgets)"
+        ]
+    scoped = {"arms": tier_budget.get("arms", {})}
+    out: List[str] = []
+    for rep in reports:
+        out.extend(
+            f"{tier_name}/{d}" for d in diff_against_budget(rep, scoped)
+        )
+    return out
+
+
+def growth_law_findings(
+    per_tier: Dict[str, Dict[str, Dict[str, Any]]],
+) -> List[str]:
+    """Cross-tier structural laws a scalable program must obey.
+
+    ``per_tier`` maps tier name -> arm -> budget entry (fresh reports
+    and/or frozen budgets — the caller overlays). Two laws, both named
+    per arm + tier + collective when broken:
+
+    - **Reshard suspects stay zero.** A full-replication reshard
+      fallback that appears at ANY tier is a scaling bug by definition —
+      its cost grows with the mesh (the PR 1 GQA fallback and the PR 8
+      composed-mesh fallback were exactly this class).
+    - **Per-collective counts grow at most linearly in the data axis.**
+      SPMD per-step collective COUNTS should be near-constant as the
+      data axis grows (each instruction just spans more devices); a
+      count that grows faster than the device ratio between two tiers —
+      or appears from zero — means the partitioner is emitting
+      per-shard chains, the structure that killed the pod-scale curves
+      in the MLPerf TPU papers. Counts may always drop.
+    """
+    findings: List[str] = []
+    tiers = sorted(
+        (t for t in per_tier if t in TOPOLOGY_TIERS),
+        key=lambda t: TOPOLOGY_TIERS[t].device_count,
+    )
+    arms = sorted({a for t in tiers for a in per_tier[t]})
+    for arm in arms:
+        present = [t for t in tiers if arm in per_tier[t]]
+        for t in present:
+            entry = per_tier[t][arm]
+            suspects = int(entry.get("replication_reshard_suspects", 0))
+            if suspects > 0:
+                findings.append(
+                    f"growth-law: {arm}@{t} has {suspects} full-replication "
+                    "reshard suspect(s) — reshard suspects must stay 0 "
+                    "across topology tiers (a reshard's cost grows with "
+                    "the mesh)"
+                )
+        for lo, hi in zip(present, present[1:]):
+            ratio = (
+                TOPOLOGY_TIERS[hi].device_count
+                / TOPOLOGY_TIERS[lo].device_count
+            )
+            lo_c = per_tier[lo][arm].get("collectives", {})
+            hi_c = per_tier[hi][arm].get("collectives", {})
+            for op in COLLECTIVE_OPS:
+                n_lo, n_hi = int(lo_c.get(op, 0)), int(hi_c.get(op, 0))
+                if n_lo == 0 and n_hi > 0:
+                    findings.append(
+                        f"growth-law: {arm} {op} appears from zero "
+                        f"({lo}: 0 -> {hi}: {n_hi}) — a collective the "
+                        "small mesh never needed is growing with the mesh"
+                    )
+                elif n_lo > 0 and n_hi > n_lo * ratio:
+                    findings.append(
+                        f"growth-law: {arm} {op} grows superlinearly in "
+                        f"the data axis ({lo}: {n_lo} -> {hi}: {n_hi}; "
+                        f"linear ceiling {int(n_lo * ratio)} at "
+                        f"{ratio:g}x devices)"
+                    )
+    return findings
+
+
+def commensurable_topology_tiers(
+    budgets: Dict[str, Any],
+    fresh_tiers: Tuple[str, ...] = (),
+    jax_version: Optional[str] = None,
+) -> Tuple[Dict[str, Any], List[str]]:
+    """(budgets view with cross-version tiers dropped, dropped tier names).
+
+    The growth laws compare counts ACROSS tiers, so overlaying a fresh
+    audit on a tier frozen under a different jax would mix incomparable
+    compiler outputs — minting spurious appears-from-zero/superlinear
+    findings (or masking real ones), the exact cross-version mixing
+    write_budgets refuses for the CPU roster. Frozen tiers whose
+    ``jax_version`` differs from the running one are excluded from the
+    overlay (fresh-audited tiers always stay: their counts ARE current).
+    """
+    if jax_version is None:
+        import jax
+
+        jax_version = jax.__version__
+    blocks = budgets.get("topology_tiers", {})
+    stale = sorted(
+        t for t, b in blocks.items()
+        if t not in fresh_tiers
+        and b.get("jax_version") not in (None, jax_version)
+    )
+    if not stale:
+        return budgets, []
+    kept = {t: b for t, b in blocks.items() if t not in stale}
+    return dict(budgets, topology_tiers=kept), stale
+
+
+def assemble_per_tier(
+    budgets: Dict[str, Any],
+    fresh: Optional[Dict[str, List[ArmReport]]] = None,
+) -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """Frozen topology budgets overlaid with fresh reports, for the
+    growth laws: an audit of ONE tier still judges growth against the
+    other tiers' frozen structure."""
+    per_tier: Dict[str, Dict[str, Dict[str, Any]]] = {
+        t: dict(block.get("arms", {}))
+        for t, block in budgets.get("topology_tiers", {}).items()
+    }
+    for tier_name, reports in (fresh or {}).items():
+        per_tier.setdefault(tier_name, {})
+        per_tier[tier_name].update(
+            {rep.arm: rep.to_budget_entry() for rep in reports}
+        )
+    return per_tier
+
+
+# ---------------------------------------------------------------------------
 # Budget file I/O + diffing
 # ---------------------------------------------------------------------------
 
@@ -377,6 +703,11 @@ def write_budgets(
         "jax_version": jax.__version__,
         "arms": dict((existing or {}).get("arms", {})),
     }
+    if existing is not None and existing.get("topology_tiers"):
+        # The topology-tier budgets are frozen by their own writer
+        # (write_topology_budgets); an arm-roster regeneration must carry
+        # them through untouched, not silently drop a whole section.
+        doc["topology_tiers"] = existing["topology_tiers"]
     if existing is not None:
         # A partial regeneration on a different jax than the file was
         # frozen on would mix incomparable counts — and silently dropping

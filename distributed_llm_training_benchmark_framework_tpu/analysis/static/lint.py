@@ -36,6 +36,11 @@ deployment invariant this codebase has already paid for once:
          dtype is float32, and one f32 constant silently promotes the
          surrounding bf16 arithmetic — exactly the bf16->f32 convert
          chains the HLO auditor budgets (``bf16_to_f32_converts``).
+- GC108  collective/axis-query calls (``psum``/``ppermute``/
+         ``all_gather``/...) inside a ``shard_map`` body naming a literal
+         axis outside the site's fully-literal ``axis_names`` set: the
+         bad axis only raises at trace time, deep inside a jit. Sites
+         whose axis set is not fully static are skipped, never guessed.
 - GC201  entrypoint<->harness flag-surface drift (PR 1's detector, now a
          registry rule): every ``train/harness.py`` flag must be reachable
          from the container env in ``docker/entrypoint.sh`` and vice versa.
@@ -620,6 +625,192 @@ def _check_implicit_f32_constants(root: str) -> Iterator[Violation]:
                 "jitted model code",
                 RULES["GC107"].fix_hint,
             )
+
+
+# ---------------------------------------------------------------------------
+# GC108: collective axis names vs the enclosing shard_map's axis set
+# ---------------------------------------------------------------------------
+
+#: Collective / axis-query callables whose axis argument GC108 checks,
+#: mapped to the positional index of that argument (kwarg ``axis_name=``
+#: is always honored too).
+_GC108_COLLECTIVES = {
+    "lax.psum": 1, "psum": 1,
+    "lax.pmean": 1, "pmean": 1,
+    "lax.pmax": 1, "pmax": 1,
+    "lax.pmin": 1, "pmin": 1,
+    "lax.ppermute": 1, "ppermute": 1,
+    "lax.all_gather": 1, "all_gather": 1,
+    "lax.all_to_all": 1, "all_to_all": 1,
+    "lax.psum_scatter": 1, "psum_scatter": 1,
+    "lax.axis_index": 0, "axis_index": 0,
+    "lax.axis_size": 0, "axis_size": 0,
+    "jax.lax.psum": 1, "jax.lax.pmean": 1, "jax.lax.ppermute": 1,
+    "jax.lax.all_gather": 1, "jax.lax.all_to_all": 1,
+}
+
+_SHARD_MAP_NAMES = (
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+)
+
+
+def _literal_axis_names(node: ast.AST) -> List[Tuple[str, int]]:
+    """(axis, lineno) for every string literal in an axis-bearing arg —
+    a bare 'data', ('pipe', 'seq') tuples, lists."""
+    out: List[Tuple[str, int]] = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append((node.value, node.lineno))
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append((el.value, el.lineno))
+    return out
+
+
+_P_NAMES = ("P", "PartitionSpec", "jax.sharding.PartitionSpec")
+
+
+def _shard_map_axis_set(call: ast.Call) -> Optional[frozenset]:
+    """The axis names one shard_map call site pins statically, or None.
+
+    The set only CLOSES when the site passes a fully-literal
+    ``axis_names=`` — that kwarg is shard_map's own declaration of the
+    manual axes, so it is the one thing that bounds what a collective
+    may legally name. Spec ``P(...)`` literals join the set as extras
+    (defensive; they must be a subset of axis_names anyway), but
+    without an explicit literal axis_names the set is OPEN and the site
+    is skipped: axis_names defaults to ALL mesh axes, and the mesh is a
+    runtime value, so spec literals alone under-approximate the legal
+    set (a psum over an unnamed mesh axis would be a false positive).
+    Any non-literal component — a partially-literal tuple
+    (("data", extra_axis)), a spec variable, a helper call — also opens
+    the set (models/moe.py's dp-conditional batch spec is the live
+    example; such sites audit through the HLO engine instead).
+    """
+    axes: set = set()
+    closed = False
+    for kw in call.keywords:
+        if kw.arg == "axis_names":
+            found = _literal_axis_names(kw.value)
+            axes.update(a for a, _ in found)
+            # Closed ONLY when every element is literal: one runtime
+            # element (("data", extra_axis)) means unknown axes exist.
+            n_elts = (
+                len(kw.value.elts)
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else 1
+            )
+            closed = bool(found) and len(found) == n_elts
+        elif kw.arg in ("in_specs", "out_specs") and kw.value is not None:
+            stack = [kw.value]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.Tuple, ast.List)):
+                    stack.extend(n.elts)
+                elif isinstance(n, ast.Call) and _dotted(n.func) in _P_NAMES:
+                    for arg in n.args:
+                        elts = (
+                            arg.elts
+                            if isinstance(arg, (ast.Tuple, ast.List))
+                            else [arg]
+                        )
+                        for el in elts:
+                            if (
+                                isinstance(el, ast.Constant)
+                                and isinstance(el.value, str)
+                            ):
+                                axes.add(el.value)
+    if not closed or not axes:
+        return None
+    return frozenset(axes)
+
+
+def _mapped_function_body(call: ast.Call, tree_ast: ast.AST) -> Optional[ast.AST]:
+    """The AST region shard_map maps over: a Lambda argument directly, or
+    the nearest same-module ``def`` a Name argument refers to."""
+    if not call.args:
+        return None
+    target = call.args[0]
+    if isinstance(target, ast.Lambda):
+        return target
+    if isinstance(target, ast.Name):
+        best: Optional[ast.FunctionDef] = None
+        for node in ast.walk(tree_ast):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name == target.id
+                and node.lineno <= call.lineno
+            ):
+                if best is None or node.lineno > best.lineno:
+                    best = node
+        return best
+    return None
+
+
+@_rule(
+    "GC108",
+    "collective-axis-outside-shard-map-axes",
+    "psum/ppermute/all_gather/... inside a shard_map body naming a literal "
+    "axis the enclosing shard_map does not define — the collective raises "
+    "(or silently binds a different mesh's axis) only at trace time, deep "
+    "inside a jit",
+    "use an axis from the shard_map's axis_names/in_specs set, or thread "
+    "the axis name in as a parameter like ops/ring_attention.py does; "
+    "suppress deliberate cross-mesh collectives with "
+    "'# graftcheck: disable=GC108'",
+)
+def _check_shard_map_collective_axes(root: str) -> Iterator[Violation]:
+    for tree in _package_files(root, ("",)):
+        for call in ast.walk(tree.ast):
+            if not (
+                isinstance(call, ast.Call)
+                and _dotted(call.func) in _SHARD_MAP_NAMES
+            ):
+                continue
+            axes = _shard_map_axis_set(call)
+            if not axes:
+                continue  # nothing statically known to check against
+            body = _mapped_function_body(call, tree.ast)
+            if body is None:
+                continue
+            # Walk the mapped region but never descend into a NESTED
+            # shard_map call — the inner map owns its own axis scope and
+            # is checked at its own call site against its own set.
+            stack = list(ast.iter_child_nodes(body))
+            region: List[ast.AST] = []
+            while stack:
+                n = stack.pop()
+                if (
+                    isinstance(n, ast.Call)
+                    and _dotted(n.func) in _SHARD_MAP_NAMES
+                ):
+                    continue
+                region.append(n)
+                stack.extend(ast.iter_child_nodes(n))
+            for sub in region:
+                if not isinstance(sub, ast.Call):
+                    continue
+                pos = _GC108_COLLECTIVES.get(_dotted(sub.func) or "")
+                if pos is None:
+                    continue
+                axis_nodes = [
+                    kw.value for kw in sub.keywords if kw.arg == "axis_name"
+                ]
+                if not axis_nodes and len(sub.args) > pos:
+                    axis_nodes = [sub.args[pos]]
+                for node in axis_nodes:
+                    for axis, line in _literal_axis_names(node):
+                        if axis in axes:
+                            continue
+                        if _suppressed(tree, line, "GC108"):
+                            continue
+                        yield Violation(
+                            "GC108", tree.rel, line,
+                            f"{_dotted(sub.func)}(..., {axis!r}) names an "
+                            f"axis outside the enclosing shard_map's set "
+                            f"{sorted(axes)}",
+                            RULES["GC108"].fix_hint,
+                        )
 
 
 # ---------------------------------------------------------------------------

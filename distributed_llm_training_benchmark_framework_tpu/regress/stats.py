@@ -85,6 +85,13 @@ SECONDARY_METRICS = (
     ("mfu_pct", True, 2.0, "rel"),
     ("peak_hbm_gb", False, 5.0, "rel"),
     ("comms_exposed_frac", False, 2.0, "abs_pp"),
+    # Scaling observatory: the 0-1 fraction of ideal per-chip throughput
+    # vs the suite's smallest-geometry base, stamped onto clean rows by
+    # analysis.scaling.stamp_results_dir before ingest. Absolute pp scale
+    # like comms_exposed_frac (a 2 pp efficiency drop at ws=8 is a
+    # regression even when the ws=8 absolute throughput sits inside its
+    # own noise floor) — the arm slug names the geometry in the gate line.
+    ("scaling_efficiency", True, 2.0, "abs_pp"),
 )
 #: Absolute-scale fallback noise floor (percentage points) below 3
 #: same-config history runs.

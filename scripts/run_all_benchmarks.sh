@@ -131,6 +131,19 @@ PROFILE="${PROFILE:-0}"
 # report so the frontier table lands in BENCHMARK_REPORT.md. Local mode
 # only — the sweep is a bench.py in-process run, not a pod matrix.
 REMAT_SWEEP="${REMAT_SWEEP:-0}"
+# Scaling observatory (scripts/scaling_suite.sh, docs/SCALING.md):
+# SCALING_SUITE=1 appends the scaling sweep's CPU dryrun smoke after the
+# matrix — 2 forced-host-device geometries end-to-end through
+# stamp -> registry -> curves -> gate -> report, proving the observatory
+# pipeline works before a pod-scale sweep is paid for. The smoke runs in
+# a throwaway tmpdir with its own registry (its tiny CPU points must
+# never pollute the suite registry's lineages). SKIP_SCALING=1 bypasses
+# even when SCALING_SUITE=1 (same escape-hatch shape as SKIP_CHAOS).
+# For a REAL scaling sweep on hardware, run scripts/scaling_suite.sh
+# directly (no --dryrun) with RESULTS_DIR/REGISTRY_DIR pointed at the
+# persistent tree.
+SCALING_SUITE="${SCALING_SUITE:-0}"
+SKIP_SCALING="${SKIP_SCALING:-0}"
 
 while [ $# -gt 0 ]; do
   case "$1" in
@@ -468,6 +481,23 @@ if [ "$REMAT_SWEEP" = "1" ] && [ "$MODE" = "local" ]; then
   else
     echo "REMAT SWEEP FAILED — last 20 log lines:"
     tail -20 "$RESULTS_DIR/remat_sweep.log" || true
+    FAIL=$((FAIL+1))
+  fi
+fi
+
+if [ "$SCALING_SUITE" = "1" ] && [ "$SKIP_SCALING" != "1" ]; then
+  echo ""
+  echo "=== Scaling observatory smoke (scripts/scaling_suite.sh --dryrun) ==="
+  SCALING_DIR=$(mktemp -d /tmp/scaling_smoke.XXXXXX)
+  # --registry pinned INSIDE the tmpdir: an operator-exported
+  # REGISTRY_DIR (the documented share-one-registry knob above) must not
+  # leak into the smoke, or its tiny CPU points ingest permanently.
+  if scripts/scaling_suite.sh --dryrun --results-dir "$SCALING_DIR" \
+       --registry "$SCALING_DIR/registry"; then
+    rm -rf "$SCALING_DIR"
+  else
+    echo "SCALING SMOKE FAILED (SKIP_SCALING=1 to override)." \
+         "Artifacts: $SCALING_DIR"
     FAIL=$((FAIL+1))
   fi
 fi

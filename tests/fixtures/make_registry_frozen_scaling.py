@@ -1,0 +1,154 @@
+"""Regenerate ``tests/fixtures/registry_frozen_scaling*`` deterministically.
+
+Un-ingested registry record payloads for the scaling-observatory pins
+(tests/test_scaling.py), built through the REAL construction path
+(``store.make_record`` on suite-shaped result rows — exactly what
+``store.ingest_results_dir`` assembles) and frozen with a fixed env
+fingerprint like the other registry fixtures.
+
+    python tests/fixtures/make_registry_frozen_scaling.py
+
+Contents (filename sort order == the tests' ingest order):
+
+- ``registry_frozen_scaling/``: two lineages spanning >= 3 device counts.
+
+  * zero2 x tinygpt tierS seq64 (WEAK: constant per-device batch) at
+    ws 1 / 2 / 4 / 8 with step-anatomy fields, so the efficiency math
+    and the waterfall attribution pin exactly: ws2 94.0% (loss 6.0 pp =
+    +3.5 comms +1.0 skew +1.5 residual), ws4 85.0% (15.0 = +11.0 +3.0
+    +1.0). ws4 carries THREE clean records (the secondary-gate noise
+    floor needs >= 3 same-config history runs); the newest is the curve
+    point. ws8 is a resume_geometry_changed record — the scaling suite's
+    reshard-on-restore stitch leg — and must render flagged, never gate.
+  * ddp x pp2-gpipe (STRONG: constant global batch) at ws 2 / 4 with
+    bubble_frac growth: ws4 90.0% (10.0 = +5.0 bubble +1.0 comms +4.0
+    residual).
+
+- ``registry_frozen_scaling_candidates/``: the injected-efficiency-
+  regression proof — a ws4 candidate whose tokens_per_sec matches the
+  baseline exactly (the primary metric stays neutral) but whose stamped
+  ``scaling_efficiency`` fell 0.85 -> 0.70: ``regress gate --all`` must
+  exit 1 naming the geometry (the arm slug) and ``scaling_efficiency``.
+
+Byte-identical by construction (fixed values, fixed env).
+"""
+
+import json
+import os
+
+from distributed_llm_training_benchmark_framework_tpu.regress import (
+    store as rstore,
+)
+from distributed_llm_training_benchmark_framework_tpu.utils.metrics import (
+    arm_slug,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "registry_frozen_scaling")
+OUT_CANDIDATES = os.path.join(HERE, "registry_frozen_scaling_candidates")
+
+FROZEN_ENV = {
+    "git_sha": "5ca1ab1e",
+    "jax_version": "0.0-frozen",
+    "device_kind": "TPU v5 lite",
+    "backend": "tpu",
+    "attention_impl": "reference",
+    "xla_scheduler_flags": "",
+}
+
+
+def _row(strategy, ws, *, tps, pdb, ga=1, pp=1, schedule="gpipe", comms=None,
+         bubble=None, skew=None, mfu=0.0, eff=None, stitched=False):
+    row = {
+        "strategy": strategy, "world_size": ws, "rank": 0, "seq_len": 64,
+        "tier": "S", "steps": 100, "warmup_steps": 5, "sync_every": 2,
+        "per_device_batch": pdb, "grad_accum": ga,
+        "tokens_per_sec": float(tps),
+        "mean_step_time_sec": round(64.0 * pdb * ga / tps, 6),
+        "mean_loss": 5.4, "peak_vram_gb": 0.9,
+        "model_family": "tinygpt", "attention_impl": "reference",
+        "tensor_parallel": 1, "sequence_parallel": 1,
+        "pipeline_parallel": pp, "pipeline_schedule": schedule,
+        "expert_parallel": 1, "n_experts": 0,
+        "param_dtype": "f32", "causal": False, "ring_zigzag": "auto",
+        "mfu_pct": mfu,
+    }
+    if comms is not None:
+        row["comms_exposed_frac"] = comms
+    if bubble is not None:
+        row["bubble_frac"] = bubble
+    if skew is not None:
+        row["straggler_skew_pct"] = skew
+    if eff is not None:
+        row["scaling_efficiency"] = eff
+    if stitched:
+        row.update(resumed=True, n_restarts=1,
+                   resume_geometry_changed=True, resume_step=75)
+    return row
+
+
+#: filename stem -> result row. Sorted stems define ingest order, so the
+#: ws4 history reads r1 -> r2 -> r3 (r3 newest = the curve point).
+RECORDS = {
+    # -- weak lineage: zero2 over dp, pdb 8 constant ------------------------
+    "a_zero2_ws1": _row("zero2", 1, tps=80000.0, pdb=8,
+                        comms=0.02, skew=0.0, mfu=38.0, eff=1.0),
+    "a_zero2_ws2": _row("zero2", 2, tps=150400.0, pdb=8,
+                        comms=0.055, skew=1.0, mfu=35.7, eff=0.94),
+    "a_zero2_ws4_r1": _row("zero2", 4, tps=271800.0, pdb=8,
+                           comms=0.128, skew=2.9, mfu=32.4, eff=0.849375),
+    "a_zero2_ws4_r2": _row("zero2", 4, tps=272100.0, pdb=8,
+                           comms=0.129, skew=2.9, mfu=32.4, eff=0.850313),
+    "a_zero2_ws4_r3": _row("zero2", 4, tps=272000.0, pdb=8,
+                           comms=0.13, skew=3.0, mfu=32.3, eff=0.85),
+    "a_zero2_ws8_stitch": _row("zero2", 8, tps=492800.0, pdb=8,
+                               comms=0.16, skew=4.0, mfu=29.2,
+                               stitched=True),
+    # -- strong lineage: ddp x pp2, global batch 4 constant -----------------
+    "b_pp2_ws2": _row("ddp", 2, tps=60000.0, pdb=4, pp=2,
+                      comms=0.01, bubble=0.25),
+    "b_pp2_ws4": _row("ddp", 4, tps=108000.0, pdb=2, pp=2,
+                      comms=0.02, bubble=0.30),
+}
+
+#: The injected regression: primary value byte-equal to the ws4 baseline,
+#: efficiency 15 pp down — only the secondary gate can catch this shape
+#: (the whole curve got slower via a FASTER base, not a slower ws4).
+CANDIDATES = {
+    "a_zero2_ws4_efficiency_regressed": _row(
+        "zero2", 4, tps=272000.0, pdb=8,
+        comms=0.13, skew=3.0, mfu=32.3, eff=0.70,
+    ),
+}
+
+
+def _freeze(out_dir, rows):
+    os.makedirs(out_dir, exist_ok=True)
+    for stem, row in rows.items():
+        arm = arm_slug(row["strategy"], row["world_size"], row["seq_len"],
+                       row["tier"], row["model_family"])
+        rec = rstore.make_record(
+            arm=arm, result_row=row, status="ok",
+            source=f"frozen-scaling:{stem}",
+        )
+        rec["env"] = dict(
+            FROZEN_ENV,
+            mesh={"world_size": row["world_size"], "tensor_parallel": 1,
+                  "sequence_parallel": 1,
+                  "pipeline_parallel": row["pipeline_parallel"],
+                  "expert_parallel": 1},
+        )
+        path = os.path.join(out_dir, f"record_{stem}.json")
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {path} ({rec['record_id']})")
+
+
+def main():
+    _freeze(OUT, RECORDS)
+    _freeze(OUT_CANDIDATES, CANDIDATES)
+
+
+if __name__ == "__main__":
+    main()

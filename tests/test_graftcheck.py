@@ -116,7 +116,7 @@ def test_lint_is_clean_on_head():
 def test_rule_catalog_is_complete():
     assert set(lint.RULES) == {
         "GC101", "GC102", "GC103", "GC104", "GC105", "GC106", "GC107",
-        "GC201",
+        "GC108", "GC201",
     }
     for rule in lint.RULES.values():
         assert rule.fix_hint and rule.description
@@ -630,3 +630,390 @@ def test_cli_lists_roster_and_rules():
     assert proc.returncode == 0
     for rule_id in lint.RULES:
         assert rule_id in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# GC108: collective axis names vs the enclosing shard_map axis set
+# ---------------------------------------------------------------------------
+
+
+def test_gc108_fires_on_axis_outside_shard_map_set(tmp_path):
+    root = _scratch_root(tmp_path, "ops/scratch.py", """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            y = lax.psum(x, "seq")          # in the set (in_specs literal)
+            z = lax.ppermute(y, "model", [(0, 1)])  # NOT in the set
+            return z
+
+        def run(mesh, x):
+            fn = jax.shard_map(
+                body, mesh=mesh, in_specs=(P("seq"),), out_specs=P("seq"),
+                axis_names=("seq",),
+            )
+            return fn(x)
+    """)
+    violations = lint.run_lint(root=root, rules=("GC108",))
+    assert len(violations) == 1
+    assert "ppermute" in violations[0].message
+    assert "'model'" in violations[0].message
+    assert "seq" in violations[0].message  # the known set is named
+
+
+def test_gc108_honors_suppression_and_axis_name_kwarg(tmp_path):
+    root = _scratch_root(tmp_path, "ops/scratch.py", """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            # graftcheck: disable=GC108
+            a = lax.all_gather(x, axis_name="model")
+            return a
+
+        def run(mesh, x):
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(P("seq"),), out_specs=P(),
+                axis_names=("seq",),
+            )(x)
+    """)
+    assert lint.run_lint(root=root, rules=("GC108",)) == []
+
+
+def test_gc108_skips_open_axis_sets(tmp_path):
+    # A spec VARIABLE (models/moe.py's dp-conditional batch spec shape)
+    # under-determines the axis set: the site must be skipped, not
+    # guessed at.
+    root = _scratch_root(tmp_path, "ops/scratch.py", """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return lax.psum(x, "data")
+
+        def run(mesh, x, xspec):
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(xspec,), out_specs=P("expert"),
+            )(x)
+    """)
+    assert lint.run_lint(root=root, rules=("GC108",)) == []
+
+
+def test_gc108_checks_lambda_bodies_and_axis_tuples(tmp_path):
+    root = _scratch_root(tmp_path, "ops/scratch.py", """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def run(mesh, x):
+            return jax.shard_map(
+                lambda v: lax.pmean(v, ("pipe", "bogus")),
+                mesh=mesh, in_specs=(P("pipe"),), out_specs=P(),
+                axis_names=("pipe",),
+            )(x)
+    """)
+    violations = lint.run_lint(root=root, rules=("GC108",))
+    assert len(violations) == 1
+    assert "'bogus'" in violations[0].message
+
+
+def test_gc108_clean_on_head():
+    assert lint.run_lint(rules=("GC108",)) == []
+
+
+# ---------------------------------------------------------------------------
+# Topology tiers: AOT audits + growth laws
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def topo_ok():
+    if not hlo_audit.topology_available():
+        pytest.skip("libtpu topology tables unavailable on this host")
+    return True
+
+
+def test_topology_tier_registry_and_frozen_budgets():
+    assert set(hlo_audit.TOPOLOGY_TIERS) == {"v5e-16", "v5e-64", "v5e-256"}
+    budgets = hlo_audit.load_budgets()
+    tiers = budgets.get("topology_tiers", {})
+    assert set(tiers) == set(hlo_audit.TOPOLOGY_TIERS), (
+        "configs/collective_budgets.json topology_tiers out of sync — "
+        "run --topology <tier> --update-budgets"
+    )
+    for name, block in tiers.items():
+        assert block["device_count"] == (
+            hlo_audit.TOPOLOGY_TIERS[name].device_count
+        )
+        assert set(block["arms"]) == set(hlo_audit.TOPOLOGY_ARMS)
+        for entry in block["arms"].values():
+            # The committed structure already obeys the reshard law.
+            assert entry["replication_reshard_suspects"] == 0
+
+
+def test_scale_spec_to_devices():
+    zero2 = hlo_audit.scale_spec_to_devices(
+        hlo_audit.ROSTER["zero2-dp8"], 64
+    )
+    assert zero2.mesh_shape == (64,)
+    assert zero2.global_batch == 16 * 8  # batch scales with the data axis
+    gqa = hlo_audit.scale_spec_to_devices(
+        hlo_audit.ROSTER["llama-tp2-gqa"], 64
+    )
+    assert gqa.mesh_shape == (32, 1, 2)  # tp degree is identity, data grows
+    assert gqa.global_batch == 64
+    with pytest.raises(ValueError, match="does not divide"):
+        hlo_audit.scale_spec_to_devices(hlo_audit.ROSTER["zero2-ep2-moe"], 7)
+
+
+def test_growth_law_findings_pure():
+    def entry(suspects=0, **ops):
+        c = {op: 0 for op in hlo_audit.COLLECTIVE_OPS}
+        c.update(ops)
+        return {"collectives": c, "replication_reshard_suspects": suspects}
+
+    # Constant counts and drops are lawful.
+    clean = {
+        "v5e-16": {"a": entry(**{"all-reduce": 8, "all-gather": 29})},
+        "v5e-64": {"a": entry(**{"all-reduce": 8, "all-gather": 0})},
+    }
+    assert hlo_audit.growth_law_findings(clean) == []
+    # Linear-in-devices growth is the ceiling; one past it is a finding.
+    at_ceiling = {
+        "v5e-16": {"a": entry(**{"all-reduce": 2})},
+        "v5e-64": {"a": entry(**{"all-reduce": 8})},
+    }
+    assert hlo_audit.growth_law_findings(at_ceiling) == []
+    superlinear = {
+        "v5e-16": {"a": entry(**{"all-reduce": 2})},
+        "v5e-64": {"a": entry(**{"all-reduce": 9})},
+    }
+    findings = hlo_audit.growth_law_findings(superlinear)
+    assert len(findings) == 1 and "superlinearly" in findings[0]
+    assert "a" in findings[0] and "all-reduce" in findings[0]
+    # A collective appearing from zero is worse than linear by definition.
+    from_zero = {
+        "v5e-16": {"a": entry()},
+        "v5e-256": {"a": entry(**{"collective-permute": 3})},
+    }
+    findings = hlo_audit.growth_law_findings(from_zero)
+    assert len(findings) == 1 and "appears from zero" in findings[0]
+    # Reshard suspects must be 0 at EVERY tier.
+    suspects = {"v5e-64": {"a": entry(suspects=5)}}
+    findings = hlo_audit.growth_law_findings(suspects)
+    assert len(findings) == 1
+    assert "must stay 0" in findings[0] and "a@v5e-64" in findings[0]
+
+
+def test_topology_audit_v5e16_head_within_budget(topo_ok):
+    """The smallest tier compiles the full scalable subset in seconds and
+    must match its frozen budgets AND the cross-tier growth laws (fresh
+    reports overlaid on the other tiers' frozen structure)."""
+    tier = hlo_audit.TOPOLOGY_TIERS["v5e-16"]
+    reports = hlo_audit.audit_topology_tier(tier)
+    budgets = hlo_audit.load_budgets()
+    deltas = hlo_audit.diff_topology_against_budget(
+        "v5e-16", reports, budgets
+    )
+    assert deltas == [], "\n".join(deltas)
+    growth = hlo_audit.growth_law_findings(
+        hlo_audit.assemble_per_tier(budgets, {"v5e-16": reports})
+    )
+    assert growth == [], "\n".join(growth)
+
+
+def test_topology_injection_breaks_growth_law(topo_ok):
+    """The acceptance injection: bad-kv-spec reintroduces the GQA
+    full-replicate fallback at topology scale — the llama arm's reshard
+    suspects go nonzero, which is both a budget delta and a growth-law
+    violation by name."""
+    tier = hlo_audit.TOPOLOGY_TIERS["v5e-16"]
+    reports = hlo_audit.audit_topology_tier(
+        tier, arm_names=("llama-tp2-gqa",), inject="bad-kv-spec"
+    )
+    (rep,) = reports
+    assert rep.replication_reshard_suspects > 0
+    budgets = hlo_audit.load_budgets()
+    deltas = hlo_audit.diff_topology_against_budget(
+        "v5e-16", reports, budgets
+    )
+    assert any("REGRESSED" in d for d in deltas), deltas
+    growth = hlo_audit.growth_law_findings(
+        hlo_audit.assemble_per_tier(budgets, {"v5e-16": reports})
+    )
+    assert any(
+        "llama-tp2-gqa@v5e-16" in g and "must stay 0" in g for g in growth
+    ), growth
+
+
+def test_cli_topology_v5e64_clean(topo_ok):
+    """The acceptance CLI: --topology v5e-64 compiles the roster subset
+    (>= 2 arms) AOT on the CPU host and verdicts budgets + growth laws."""
+    proc = _cli("--topology", "v5e-64")
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "graftcheck topology: 1 tier(s), 0 finding(s)" in proc.stderr
+    assert proc.stderr.count("compiling 3 arm(s)") == 1
+
+
+def test_cli_topology_injection_exits_one(topo_ok):
+    proc = _cli("--topology", "v5e-16", "--inject", "bad-kv-spec")
+    assert proc.returncode == 1, proc.stderr[-3000:]
+    assert "must stay 0" in proc.stderr
+    assert "llama-tp2-gqa" in proc.stderr
+
+
+def test_cli_topology_unknown_tier_exits_two():
+    proc = _cli("--topology", "v5e-9999")
+    assert proc.returncode == 2
+    assert "unknown topology tier" in proc.stderr
+
+
+def test_all_includes_default_topology_tiers_in_script():
+    # --all picks up the default tiers (16 + 64) without disturbing the
+    # frozen CPU arm budgets; v5e-256 stays explicit (compile cost).
+    assert hlo_audit.TOPOLOGY_DEFAULT_TIERS == ("v5e-16", "v5e-64")
+    budgets = hlo_audit.load_budgets()
+    assert set(budgets["arms"]) == set(hlo_audit.ROSTER)  # untouched
+
+
+def test_update_budgets_preserves_topology_section(gqa_report, tmp_path):
+    # An arm-roster regeneration must carry topology_tiers through.
+    live = hlo_audit.load_budgets()
+    assert "topology_tiers" in live
+    path = str(tmp_path / "budgets.json")
+    hlo_audit.write_budgets([gqa_report], path, existing=live)
+    merged = hlo_audit.load_budgets(path)
+    assert merged["topology_tiers"] == live["topology_tiers"]
+
+
+def test_gc108_partially_literal_axis_names_opens_the_set(tmp_path):
+    # ("data", extra_axis): one runtime element means unknown axes exist
+    # — the site must be skipped, not judged against the literal half.
+    root = _scratch_root(tmp_path, "ops/scratch.py", """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return lax.psum(x, "model")
+
+        def run(mesh, x, extra_axis):
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+                axis_names=("data", extra_axis),
+            )(x)
+    """)
+    assert lint.run_lint(root=root, rules=("GC108",)) == []
+
+
+def test_gc108_no_axis_names_means_open_set(tmp_path):
+    # Without a literal axis_names=, shard_map's manual set defaults to
+    # ALL mesh axes — a runtime value — so fully-literal specs alone must
+    # NOT close the set (a psum over an unnamed mesh axis is legal).
+    root = _scratch_root(tmp_path, "ops/scratch.py", """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def body(x):
+            return lax.psum(x, "model")
+
+        def run(mesh, x):
+            return jax.shard_map(
+                body, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"),
+            )(x)
+    """)
+    assert lint.run_lint(root=root, rules=("GC108",)) == []
+
+
+def test_commensurable_topology_tiers_filters_cross_version():
+    budgets = {"topology_tiers": {
+        "v5e-16": {"jax_version": "0.9.9", "arms": {}},
+        "v5e-64": {"jax_version": "0.4.37", "arms": {}},
+        "v5e-256": {"jax_version": "0.4.37", "arms": {}},
+    }}
+    # A fresh v5e-16 audit stays (its counts ARE the running compiler's);
+    # no other tier is stale at the matching version.
+    kept, stale = hlo_audit.commensurable_topology_tiers(
+        budgets, fresh_tiers=("v5e-16",), jax_version="0.4.37"
+    )
+    assert stale == []
+    # Without the fresh overlay, the off-version tier drops with a name.
+    kept, stale = hlo_audit.commensurable_topology_tiers(
+        budgets, fresh_tiers=(), jax_version="0.4.37"
+    )
+    assert stale == ["v5e-16"]
+    assert set(kept["topology_tiers"]) == {"v5e-64", "v5e-256"}
+    # The input document is never mutated.
+    assert set(budgets["topology_tiers"]) == {"v5e-16", "v5e-64", "v5e-256"}
+
+
+def test_topology_freeze_never_touches_roster_budgets_with_lint(tmp_path):
+    # `--topology X --update-budgets --lint` must freeze ONLY the
+    # topology section: a read-only lint flag cannot flip the invocation
+    # into regenerating the CPU arm budgets (the no-silent-churn rule).
+    import json as _json
+    import shutil
+
+    path = str(tmp_path / "budgets.json")
+    shutil.copy(hlo_audit.DEFAULT_BUDGETS_PATH, path)
+    before = _json.load(open(path))
+    if not hlo_audit.topology_available():
+        pytest.skip("libtpu topology tables unavailable on this host")
+    proc = _cli("--topology", "v5e-16", "--update-budgets", "--lint",
+                "--budgets", path)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "graftcheck audit:" not in proc.stderr  # roster audit never ran
+    after = _json.load(open(path))
+    assert after["arms"] == before["arms"]
+    assert after["jax_version"] == before["jax_version"]
+
+
+def test_gc108_nested_shard_map_owns_its_own_axis_scope(tmp_path):
+    # A collective inside an INNER shard_map must be judged against the
+    # inner site's axis set, never the enclosing one — and the inner
+    # site's own literal set still fires on a genuinely bad axis.
+    root = _scratch_root(tmp_path, "ops/scratch.py", """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def outer_body(x):
+            inner = jax.shard_map(
+                lambda v: lax.psum(v, "model"),
+                mesh=None, in_specs=(P("model"),), out_specs=P(),
+                axis_names=("model",),
+            )
+            return inner(lax.psum(x, "data"))
+
+        def run(mesh, x):
+            return jax.shard_map(
+                outer_body, mesh=mesh, in_specs=(P("data"),),
+                out_specs=P(), axis_names=("data",),
+            )(x)
+    """)
+    assert lint.run_lint(root=root, rules=("GC108",)) == []
+    bad = _scratch_root(tmp_path / "bad", "ops/scratch.py", """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def outer_body(x):
+            inner = jax.shard_map(
+                lambda v: lax.psum(v, "bogus"),
+                mesh=None, in_specs=(P("model"),), out_specs=P(),
+                axis_names=("model",),
+            )
+            return inner(x)
+
+        def run(mesh, x):
+            return jax.shard_map(
+                outer_body, mesh=mesh, in_specs=(P("data"),),
+                out_specs=P(), axis_names=("data",),
+            )(x)
+    """)
+    violations = lint.run_lint(root=bad, rules=("GC108",))
+    assert len(violations) == 1 and "'bogus'" in violations[0].message

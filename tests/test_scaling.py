@@ -1,0 +1,450 @@
+"""Scaling observatory tests (analysis/scaling.py + gate + suite wiring).
+
+- The committed frozen registry fixture
+  (``tests/fixtures/registry_frozen_scaling/`` + its generator) pins the
+  efficiency math, the waterfall attribution split, and the curve table
+  rendering bit-for-bit across >= 3 device counts.
+- The injected-efficiency-regression proof: ingesting the frozen
+  candidate (same tokens/sec, scaling_efficiency 0.85 -> 0.70) makes
+  ``regress gate --all`` exit 1 naming the geometry (arm slug) and
+  ``scaling_efficiency``.
+- ``stamp_results_dir`` writes the fraction into clean result rows only
+  (resumed/healed/partial rows are never stamped and never the base).
+- make_report grows the scaling section; run_all_benchmarks.sh carries
+  the SCALING_SUITE=1 / SKIP_SCALING=1 wiring; scripts/scaling_suite.sh
+  carries the dryrun + stitch-leg contract.
+"""
+
+import glob
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+FROZEN = os.path.join(FIXTURES, "registry_frozen_scaling")
+FROZEN_CANDIDATES = os.path.join(
+    FIXTURES, "registry_frozen_scaling_candidates"
+)
+
+from distributed_llm_training_benchmark_framework_tpu.analysis import (  # noqa: E402
+    scaling,
+)
+from distributed_llm_training_benchmark_framework_tpu.regress import (  # noqa: E402
+    compare as rcompare,
+)
+from distributed_llm_training_benchmark_framework_tpu.regress import (  # noqa: E402
+    stats as rstats,
+)
+from distributed_llm_training_benchmark_framework_tpu.regress import (  # noqa: E402
+    store as rstore,
+)
+
+
+def _ingest_dir(reg, fixture_dir):
+    for path in sorted(glob.glob(os.path.join(fixture_dir, "record_*.json"))):
+        reg.ingest(json.load(open(path)))
+
+
+@pytest.fixture
+def frozen_registry(tmp_path):
+    reg = rstore.Registry(str(tmp_path / "registry"))
+    _ingest_dir(reg, FROZEN)
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# Fixture integrity
+# ---------------------------------------------------------------------------
+
+
+def test_fixture_generator_is_deterministic(tmp_path, monkeypatch):
+    """Re-running the committed generator reproduces the committed fixture
+    byte-for-byte — the regeneration story every frozen fixture carries."""
+    sys.path.insert(0, FIXTURES)
+    try:
+        import make_registry_frozen_scaling as gen
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(gen, "OUT", str(tmp_path / "scaling"))
+    monkeypatch.setattr(gen, "OUT_CANDIDATES", str(tmp_path / "cand"))
+    gen.main()
+    for committed, regen in ((FROZEN, tmp_path / "scaling"),
+                             (FROZEN_CANDIDATES, tmp_path / "cand")):
+        committed_files = sorted(os.listdir(committed))
+        assert committed_files == sorted(os.listdir(regen))
+        for fn in committed_files:
+            assert (
+                open(os.path.join(committed, fn)).read()
+                == open(os.path.join(regen, fn)).read()
+            ), fn
+
+
+def test_fixture_spans_three_device_counts(frozen_registry):
+    curves, _ = scaling.build_curves(frozen_registry)
+    ws = {p.world_size for c in curves for p in c.points}
+    assert {1, 2, 4, 8} <= ws  # >= 3 device counts, per the issue contract
+
+
+# ---------------------------------------------------------------------------
+# Curve assembly: efficiency math + waterfall, pinned
+# ---------------------------------------------------------------------------
+
+
+def test_weak_and_strong_classification(frozen_registry):
+    curves, _ = scaling.build_curves(frozen_registry)
+    modes = {c.lineage["strategy"]: c.mode for c in curves}
+    assert modes == {"zero2": "weak", "ddp": "strong"}
+
+
+def test_efficiency_math_pinned(frozen_registry):
+    curves, _ = scaling.build_curves(frozen_registry)
+    (zero2,) = [c for c in curves if c.lineage["strategy"] == "zero2"]
+    by_ws = {p.world_size: p for p in zero2.points}
+    assert zero2.base_world_size == 1
+    assert by_ws[1].efficiency_pct == 100.0
+    assert by_ws[2].efficiency_pct == 94.0
+    assert by_ws[4].efficiency_pct == 85.0  # the NEWEST ws4 record wins
+    assert by_ws[4].tokens_per_sec == 272000.0
+    assert by_ws[8].efficiency_pct == 77.0
+
+
+def test_waterfall_attribution_pinned(frozen_registry):
+    """The split at each point: anatomy growth vs base, residual closes
+    the books exactly (loss == dcomms + dbubble + dskew + residual)."""
+    curves, _ = scaling.build_curves(frozen_registry)
+    (zero2,) = [c for c in curves if c.lineage["strategy"] == "zero2"]
+    p2 = next(p for p in zero2.points if p.world_size == 2)
+    assert (p2.loss_pp, p2.d_comms_pp, p2.d_skew_pp, p2.residual_pp) == (
+        6.0, 3.5, 1.0, 1.5
+    )
+    p4 = next(p for p in zero2.points if p.world_size == 4)
+    assert (p4.loss_pp, p4.d_comms_pp, p4.d_skew_pp, p4.residual_pp) == (
+        15.0, 11.0, 3.0, 1.0
+    )
+    assert p4.d_bubble_pp is None  # no pipeline on this lineage
+    (pp,) = [c for c in curves if c.lineage["strategy"] == "ddp"]
+    p4 = next(p for p in pp.points if p.world_size == 4)
+    assert (p4.loss_pp, p4.d_comms_pp, p4.d_bubble_pp, p4.residual_pp) == (
+        10.0, 1.0, 5.0, 4.0
+    )
+
+
+def test_stitched_point_is_flagged_and_never_base(frozen_registry):
+    curves, _ = scaling.build_curves(frozen_registry)
+    (zero2,) = [c for c in curves if c.lineage["strategy"] == "zero2"]
+    p8 = next(p for p in zero2.points if p.world_size == 8)
+    assert p8.flags == ("stitched",)
+    assert zero2.base_world_size == 1  # the stitched point cannot anchor
+
+
+def test_curve_table_renders_bit_for_bit(frozen_registry):
+    curves, _ = scaling.build_curves(frozen_registry)
+    (zero2,) = [c for c in curves if c.lineage["strategy"] == "zero2"]
+    assert scaling.format_curve(zero2) == (
+        "-- zero2 x tinygpt tierS seq64 [weak scaling, 4 points, "
+        "base ws=1] --\n"
+        "  ws  b/dev  acc    tokens/s  tok/s/chip   MFU%    eff%  "
+        "dcomms  dbubble  dskew   resid  flags\n"
+        "   1      8    1      80,000      80,000   38.0   100.0  "
+        "    --       --     --      --  base\n"
+        "   2      8    1     150,400      75,200   35.7    94.0  "
+        "  +3.5       --   +1.0    +1.5\n"
+        "   4      8    1     272,000      68,000   32.3    85.0  "
+        " +11.0       --   +3.0    +1.0\n"
+        "   8      8    1     492,800      61,600   29.2    77.0  "
+        " +14.0       --   +4.0    +5.0  STITCHED"
+    )
+    (pp,) = [c for c in curves if c.lineage["strategy"] == "ddp"]
+    assert scaling.format_curve(pp) == (
+        "-- ddp x pp2-gpipe x tinygpt tierS seq64 [strong scaling, "
+        "2 points, base ws=2] --\n"
+        "  ws  b/dev  acc    tokens/s  tok/s/chip   MFU%    eff%  "
+        "dcomms  dbubble  dskew   resid  flags\n"
+        "   2      4    1      60,000      30,000      -   100.0  "
+        "    --       --     --      --  base\n"
+        "   4      2    1     108,000      27,000      -    90.0  "
+        "  +1.0     +5.0     --    +4.0"
+    )
+
+
+def test_stitched_point_attaches_across_run_length(tmp_path):
+    """A stitch leg runs a few steps past the source's final checkpoint,
+    so its `steps` differs — it must still attach to the clean curve
+    (flagged), exactly once, and only when the match is unambiguous."""
+    reg = rstore.Registry(str(tmp_path))
+    _ingest_dir(reg, FROZEN)
+    stitched = json.load(open(os.path.join(
+        FROZEN, "record_a_zero2_ws8_stitch.json"
+    )))
+    row = dict(stitched["result"], steps=103, world_size=16,
+               tokens_per_sec=900000.0)
+    rec = rstore.make_record(
+        arm="zero2_ws16_seq64_tierS", result_row=row, status="ok",
+        source="test:stitch-steps",
+    )
+    reg.ingest(rec)
+    curves, _ = scaling.build_curves(reg)
+    (zero2,) = [c for c in curves if c.lineage["strategy"] == "zero2"]
+    p16 = next(p for p in zero2.points if p.world_size == 16)
+    assert p16.flags == ("stitched",)
+    assert zero2.lineage["steps"] == 100  # the CLEAN lineage won
+
+
+def test_partial_records_excluded_with_count(tmp_path):
+    reg = rstore.Registry(str(tmp_path))
+    _ingest_dir(reg, FROZEN)
+    partial_row = dict(
+        json.load(open(os.path.join(FROZEN, "record_a_zero2_ws2.json")))
+        ["result"], partial=True, tokens_per_sec=1.0,
+    )
+    reg.ingest(rstore.make_record(
+        arm="zero2_ws2_seq64_tierS", result_row=partial_row,
+        status="partial", source="test:partial",
+        metric={"name": "tokens_per_sec", "value": 1.0,
+                "higher_is_better": True},
+    ))
+    curves, n_partial = scaling.build_curves(reg)
+    assert n_partial == 1
+    (zero2,) = [c for c in curves if c.lineage["strategy"] == "zero2"]
+    p2 = next(p for p in zero2.points if p.world_size == 2)
+    assert p2.tokens_per_sec == 150400.0  # the partial never took the slot
+    assert "partial" in scaling.format_report(curves, n_partial, "r")
+
+
+def test_png_and_json_render(frozen_registry, tmp_path):
+    curves, n_partial = scaling.build_curves(frozen_registry)
+    png = scaling.write_curves_png(curves, str(tmp_path / "curves.png"))
+    assert png and os.path.getsize(png) > 0
+    doc = scaling.curves_to_json(curves, n_partial)
+    assert len(doc["curves"]) == 2
+    assert doc["excluded_partial_records"] == 0
+    json.dumps(doc)  # serializable
+
+
+# ---------------------------------------------------------------------------
+# Gate: scaling_efficiency is a named secondary metric
+# ---------------------------------------------------------------------------
+
+
+def test_scaling_efficiency_registered_as_secondary_metric():
+    entries = {e[0]: e for e in rstats.SECONDARY_METRICS}
+    assert entries["scaling_efficiency"] == (
+        "scaling_efficiency", True, 2.0, "abs_pp"
+    )
+
+
+def test_gate_aa_exits_zero_on_frozen_fixture(frozen_registry, capsys):
+    rc = rcompare.main(["--registry", frozen_registry.root, "gate", "--all"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "REGRESSION" not in out
+    # The stitched ws8 record is skipped by the gate, visibly.
+    assert "zero2_ws8_seq64_tierS" in out and "resumed (stitched)" in out
+
+
+def test_injected_efficiency_regression_fails_gate_by_name(
+    frozen_registry, capsys,
+):
+    """The acceptance proof: the frozen candidate keeps tokens/sec
+    byte-identical to the baseline (the primary metric cannot catch it)
+    but its stamped efficiency fell 15 pp — gate exits 1 naming the
+    geometry (the arm slug) and scaling_efficiency, in pp units."""
+    _ingest_dir(frozen_registry, FROZEN_CANDIDATES)
+    rc = rcompare.main(["--registry", frozen_registry.root, "gate", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = next(l for l in out.splitlines() if "REGRESSION" in l)
+    assert "arm=zero2_ws4_seq64_tierS" in line
+    assert "metric=scaling_efficiency" in line
+    assert "delta=-15.00pp" in line
+
+
+def test_regressed_candidate_never_becomes_curve_point(
+    frozen_registry, capsys,
+):
+    """Gate-banked regressions leave the curves too: after the gate banks
+    the injected candidate, the curve's ws4 point is the old clean one."""
+    _ingest_dir(frozen_registry, FROZEN_CANDIDATES)
+    assert rcompare.main(
+        ["--registry", frozen_registry.root, "gate", "--all"]
+    ) == 1  # banks the candidate
+    capsys.readouterr()
+    curves, _ = scaling.build_curves(frozen_registry)
+    (zero2,) = [c for c in curves if c.lineage["strategy"] == "zero2"]
+    p4 = next(p for p in zero2.points if p.world_size == 4)
+    assert p4.efficiency_pct == 85.0
+
+
+# ---------------------------------------------------------------------------
+# Result-row stamping
+# ---------------------------------------------------------------------------
+
+
+def _result_file(d, name, row):
+    path = os.path.join(d, name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(row, f)
+    return path
+
+
+def _suite_row(ws, tps, **kw):
+    row = {
+        "strategy": "fsdp", "world_size": ws, "seq_len": 64, "tier": "S",
+        "model_family": "tinygpt", "per_device_batch": 2, "grad_accum": 1,
+        "steps": 12, "warmup_steps": 2, "sync_every": 2,
+        "attention_impl": "reference", "tensor_parallel": 1,
+        "sequence_parallel": 1, "pipeline_parallel": 1,
+        "pipeline_schedule": "gpipe", "expert_parallel": 1, "n_experts": 0,
+        "param_dtype": "f32", "causal": False, "ring_zigzag": "auto",
+        "tokens_per_sec": float(tps),
+    }
+    row.update(kw)
+    return row
+
+
+def test_stamp_results_dir_writes_fraction_to_clean_rows(tmp_path):
+    d = str(tmp_path)
+    p1 = _result_file(d, "result_fsdp_ws1_seq64_tierS.json",
+                      _suite_row(1, 1000.0))
+    p2 = _result_file(os.path.join(d, "sub"),
+                      "result_fsdp_ws2_seq64_tierS.json",
+                      _suite_row(2, 1700.0))
+    stamped = scaling.stamp_results_dir(d)
+    assert {os.path.basename(p) for p, _ in stamped} == {
+        "result_fsdp_ws1_seq64_tierS.json",
+        "result_fsdp_ws2_seq64_tierS.json",
+    }
+    assert json.load(open(p1))["scaling_efficiency"] == 1.0
+    assert json.load(open(p2))["scaling_efficiency"] == 0.85
+    # Idempotent: re-stamping writes the same values.
+    again = scaling.stamp_results_dir(d)
+    assert sorted(v for _, v in again) == sorted(v for _, v in stamped)
+
+
+def test_stamp_skips_stitched_and_never_bases_on_them(tmp_path):
+    d = str(tmp_path)
+    stitched = _result_file(
+        d, "stitch/result_fsdp_ws1_seq64_tierS.json",
+        _suite_row(1, 10.0, resumed=True, resume_geometry_changed=True,
+                   steps=15),
+    )
+    clean1 = _result_file(d, "a/result_fsdp_ws1_seq64_tierS.json",
+                          _suite_row(1, 1000.0))
+    clean2 = _result_file(d, "b/result_fsdp_ws2_seq64_tierS.json",
+                          _suite_row(2, 1600.0))
+    scaling.stamp_results_dir(d)
+    assert "scaling_efficiency" not in json.load(open(stitched))
+    assert json.load(open(clean1))["scaling_efficiency"] == 1.0
+    # Base = the CLEAN ws1 row (1000/chip), not the stitched 10/chip.
+    assert json.load(open(clean2))["scaling_efficiency"] == 0.8
+
+
+def test_stamp_groups_by_lineage(tmp_path):
+    # Two strategies in one tree never normalize against each other.
+    d = str(tmp_path)
+    a = _result_file(d, "a/result_ddp_ws1_seq64_tierS.json",
+                     _suite_row(1, 1000.0, strategy="ddp"))
+    b = _result_file(d, "b/result_fsdp_ws1_seq64_tierS.json",
+                     _suite_row(1, 500.0))
+    scaling.stamp_results_dir(d)
+    assert json.load(open(a))["scaling_efficiency"] == 1.0
+    assert json.load(open(b))["scaling_efficiency"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Report + suite wiring
+# ---------------------------------------------------------------------------
+
+
+def test_make_report_scaling_section(frozen_registry):
+    import pandas as pd
+
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        make_report,
+    )
+
+    df = pd.DataFrame([
+        {"strategy": "zero2", "world_size": 1, "seq_len": 64,
+         "tokens_per_sec": 80000.0, "mean_step_time_sec": 0.01,
+         "peak_vram_gb": 1.0, "scaling_efficiency_pct": 100.0},
+    ])
+    report = make_report.build_report(
+        df, registry_root=frozen_registry.root
+    )
+    assert "## Scaling curves" in report
+    assert "weak scaling" in report and "strong scaling" in report
+    assert "| 8 | 492,800 | 61,600 |" in report  # the stitched row ...
+    assert "stitched" in report                  # ... carries its flag
+
+
+def test_scaling_section_absent_without_curves(tmp_path):
+    assert scaling.scaling_section(str(tmp_path / "nope")) == []
+
+
+def test_cli_curves_and_stamp_modes(frozen_registry, tmp_path, capsys):
+    rc = scaling.main([
+        "--registry", frozen_registry.root, "--out", str(tmp_path),
+        "--png", "--json",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "zero2 x tinygpt tierS seq64 [weak scaling" in out
+    assert os.path.exists(tmp_path / "scaling_curves.png")
+    assert os.path.exists(tmp_path / "scaling_curves.json")
+    d = tmp_path / "results"
+    _result_file(str(d), "result_fsdp_ws1_seq64_tierS.json",
+                 _suite_row(1, 1000.0))
+    rc = scaling.main(["--stamp-results-dir", str(d)])
+    assert rc == 0
+    assert "1 row(s) stamped" in capsys.readouterr().out
+
+
+def test_cli_missing_registry_is_operational_error(tmp_path, capsys):
+    rc = scaling.main(["--registry", str(tmp_path / "absent")])
+    assert rc == 2
+    assert "no registry" in capsys.readouterr().err
+
+
+def test_scaling_suite_script_contract():
+    path = os.path.join(REPO, "scripts", "scaling_suite.sh")
+    assert os.stat(path).st_mode & stat.S_IXUSR
+    body = open(path).read()
+    # The dryrun smoke, the stitch legs, and the full pipeline order.
+    assert "--dryrun" in body
+    assert "-stitch" in body and "-shrink" in body and "--resume" in body
+    assert "--stamp-results-dir" in body
+    assert "gate --all" in body
+    assert body.index("stamp-results-dir") < body.index("ingest"), (
+        "efficiency must be stamped BEFORE registry ingest or the records "
+        "never carry it"
+    )
+
+
+def test_run_all_wires_scaling_suite_behind_flag():
+    body = open(os.path.join(REPO, "scripts", "run_all_benchmarks.sh")).read()
+    assert 'SCALING_SUITE="${SCALING_SUITE:-0}"' in body
+    assert 'SKIP_SCALING="${SKIP_SCALING:-0}"' in body
+    assert "scaling_suite.sh --dryrun" in body
+
+
+def test_parse_metrics_never_bases_efficiency_on_stitched_rows():
+    import pandas as pd
+
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        parse_metrics,
+    )
+
+    df = pd.DataFrame([
+        _suite_row(1, 10.0, resumed=True, resume_geometry_changed=True),
+        _suite_row(1, 1000.0, resumed=False, resume_geometry_changed=False),
+        _suite_row(2, 1600.0, resumed=False, resume_geometry_changed=False),
+    ])
+    out = parse_metrics.add_scaling_efficiency(df)
+    clean_ws2 = out[(out["world_size"] == 2)].iloc[0]
+    # Reference formula vs the CLEAN ws1 row: 1600 / (1000 * 2) = 80%.
+    assert clean_ws2["scaling_efficiency_pct"] == pytest.approx(80.0)

@@ -427,9 +427,12 @@ def test_grad_explode_heals_via_loss_envelope(tmp_path):
     assert kinds == ["loss_collapse"], kinds
 
 
-def test_sentinel_without_checkpoint_fails_loudly(tmp_path):
-    # No --checkpoint-dir: the sentinel trips but cannot heal — the run
-    # must fail loudly (SentinelTripped), never publish the poisoned row.
+def test_sentinel_without_checkpoint_heals_via_snapshot(tmp_path):
+    # Cheap-rollback (scaling round, self-healing follow-up (b)): a run
+    # with no checkpoint cadence used to refuse to heal; now the loop
+    # holds an in-memory host params/opt-state snapshot taken before the
+    # first dispatch and rolls back to it — a short smoke run heals
+    # instead of dying, with the exact same n_rollbacks ledger.
     from distributed_llm_training_benchmark_framework_tpu.parallel import (
         get_strategy,
     )
@@ -437,8 +440,43 @@ def test_sentinel_without_checkpoint_fails_loudly(tmp_path):
         run_benchmark,
     )
 
+    result = run_benchmark(
+        strategy=get_strategy("ddp"), tier="S", seq_len=32, steps=14,
+        warmup_steps=2, per_device_batch=1, grad_accum=1, world_size=1,
+        results_dir=str(tmp_path / "results"),
+        sync_every=2, sentinel=True,
+        inject_fault="grad-explode@9", telemetry=True, heartbeat_sec=0,
+    )
+    assert result.n_rollbacks == 1
+    # The snapshot predates step 0, so the whole run replays.
+    assert result.rollback_steps_replayed >= 9
+    events = [json.loads(l) for l in
+              open(tmp_path / "results" / f"telemetry_{ARM}.jsonl")]
+    rbs = [e for e in events if e["event"] == "rollback"]
+    assert len(rbs) == 1 and rbs[0]["to_step"] == -1
+    assert (tmp_path / "results" / f"result_{ARM}.json").exists()
+
+
+def test_sentinel_unhealable_still_fails_loudly(tmp_path, monkeypatch):
+    # The loud-failure contract survives the snapshot: when no rollback
+    # is allowed (MAX_ROLLBACKS spent — emulated here by a sentinel whose
+    # budget is zero), the trip must raise SentinelTripped and never
+    # publish the poisoned row.
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train import (
+        loop as loop_mod,
+    )
+
+    class NoHealSentinel(faults.NumericsSentinel):
+        @property
+        def rollback_allowed(self):
+            return False
+
+    monkeypatch.setattr(loop_mod, "NumericsSentinel", NoHealSentinel)
     with pytest.raises(faults.SentinelTripped):
-        run_benchmark(
+        loop_mod.run_benchmark(
             strategy=get_strategy("ddp"), tier="S", seq_len=32, steps=14,
             warmup_steps=2, per_device_batch=1, grad_accum=1, world_size=1,
             results_dir=str(tmp_path / "results"),
