@@ -49,6 +49,83 @@ def _force_cpu_audit_env() -> None:
         pass
 
 
+def _git_changed_files():
+    """Repo-relative paths changed vs the merge-base with the default
+    branch, plus staged/unstaged/untracked work. Tuple (possibly empty);
+    None only when git itself is unavailable — the caller then falls
+    back to a full lint rather than silently passing.
+    """
+    import subprocess
+
+    from .hlo_audit import REPO_ROOT
+
+    def git(*a):
+        try:
+            out = subprocess.run(
+                ["git", *a], cwd=REPO_ROOT, capture_output=True,
+                text=True, timeout=30,
+            )
+        except Exception:
+            return None
+        return out.stdout if out.returncode == 0 else None
+
+    if git("rev-parse", "HEAD") is None:
+        # No git (or not a repo): the caller must fall back to a FULL
+        # lint — an empty changed set here would pass the pre-commit
+        # hook without linting anything.
+        return None
+    # git emits toplevel-relative paths; Violation.path is
+    # REPO_ROOT-relative. When this checkout is a SUBDIRECTORY of a
+    # larger repo the two bases differ, and comparing them unrebased
+    # would scope every finding to nothing — the same silent-pass mode
+    # as the no-git case. Rebase (and drop files outside this project).
+    toplevel = (git("rev-parse", "--show-toplevel") or "").strip()
+    prefix = ""
+    if toplevel:
+        rel = os.path.relpath(os.path.abspath(REPO_ROOT), toplevel)
+        if rel not in (".", ""):
+            if rel.startswith(".."):
+                return None  # REPO_ROOT outside the repo git sees: full lint
+            prefix = rel.replace(os.sep, "/") + "/"
+
+    def rebase(path):
+        path = path.replace(os.sep, "/")
+        if not prefix:
+            return path
+        if path.startswith(prefix):
+            return path[len(prefix):]
+        return None
+    base = None
+    for ref in ("origin/main", "origin/master", "main", "master"):
+        out = git("merge-base", "HEAD", ref)
+        if out and out.strip():
+            base = out.strip()
+            break
+    files = set()
+    # Committed + working-tree changes vs the merge-base (diff against a
+    # commit includes staged AND unstaged edits), plus untracked files.
+    # `git diff` paths are toplevel-relative regardless of cwd;
+    # `ls-files` paths are cwd-relative, so run everything from
+    # REPO_ROOT (the subprocess cwd above) and rebase the diff output.
+    if base:
+        out = git("diff", "--name-only", base)
+    else:
+        out = git("diff", "--name-only", "HEAD")
+    if out:
+        files.update(
+            r for l in out.splitlines() if l.strip()
+            for r in (rebase(l.strip()),) if r is not None
+        )
+    out = git("ls-files", "--others", "--exclude-standard")
+    if out:
+        # cwd-relative (== REPO_ROOT-relative) already.
+        files.update(
+            l.strip().replace(os.sep, "/")
+            for l in out.splitlines() if l.strip()
+        )
+    return tuple(sorted(files))
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m distributed_llm_training_benchmark_framework_tpu"
@@ -62,6 +139,12 @@ def main(argv=None) -> int:
                    help="run the HLO collective-budget auditor")
     p.add_argument("--lint", action="store_true",
                    help="run the AST lint rules")
+    p.add_argument("--changed", action="store_true",
+                   help="fast pre-commit mode: lint ONLY files changed vs "
+                        "the merge-base with the default branch (plus "
+                        "staged/unstaged/untracked work) — no audits. "
+                        "Rules still read unchanged files for context; "
+                        "findings are scoped to the changed set")
     p.add_argument("--arms", default=None,
                    help="comma-separated arm subset for --audit "
                         "(default: the whole roster)")
@@ -86,13 +169,21 @@ def main(argv=None) -> int:
     p.add_argument("--json", action="store_true",
                    help="emit the audit reports as JSON on stdout")
     p.add_argument("--inject", default=None,
-                   choices=["bad-kv-spec", "bad-fsdp-axis"],
+                   choices=["bad-kv-spec", "bad-fsdp-axis",
+                            "bad-pipeline-spec"],
                    help="self-test: deliberately reintroduce a known-bad "
-                        "sharding (bad-kv-spec = the PR 1 GQA kv "
+                        "configuration (bad-kv-spec = the PR 1 GQA kv "
                         "full-replicate fallback; bad-fsdp-axis = the "
-                        "pre-round-8 composed dp x tp fsdp placement) — "
-                        "the audit MUST then fail")
+                        "pre-round-8 composed dp x tp fsdp placement; "
+                        "bad-pipeline-spec = the seed-old typed-key "
+                        "shard_map boundary that broke the interleaved "
+                        "arm's compile) — the audit MUST then fail")
     args = p.parse_args(argv)
+
+    if args.changed and (args.all or args.audit or args.topology
+                         or args.update_budgets):
+        p.error("--changed is the fast lint-only pre-commit path; run the "
+                "audits separately (--all / --audit / --topology)")
 
     if args.inject and args.update_budgets:
         # Freezing deliberately-injected-bad counts as the new budget would
@@ -118,6 +209,11 @@ def main(argv=None) -> int:
             geom = "x".join(map(str, spec.mesh_shape))
             print(f"{spec.name}: {spec.strategy} x {spec.model_family} x "
                   f"mesh {geom} {spec.axes}")
+        for spec in hlo_audit.PIPELINE_ROSTER.values():
+            geom = "x".join(map(str, spec.mesh_shape))
+            print(f"[pipeline] {spec.name}: {spec.pipeline_schedule} "
+                  f"(V={spec.virtual_stages}) x {spec.model_family} x "
+                  f"mesh {geom} M={spec.grad_accum}")
         for tier in hlo_audit.TOPOLOGY_TIERS.values():
             print(f"[topology] {tier.name}: {tier.topology_name} "
                   f"({tier.device_count} devices; arms "
@@ -143,16 +239,31 @@ def main(argv=None) -> int:
     # write_budgets carries the other section through untouched.
     do_audit = (args.all or args.audit
                 or (args.update_budgets and not topo_tiers))
-    do_lint = args.all or args.lint
+    do_lint = args.all or args.lint or args.changed
     do_topology = bool(topo_tiers) or args.all
     if not (do_audit or do_lint or do_topology):
-        p.error("nothing to do: pass --all, --audit, --lint, --topology or "
-                "--update-budgets")
+        p.error("nothing to do: pass --all, --audit, --lint, --changed, "
+                "--topology or --update-budgets")
 
     failures = 0
 
     if do_lint:
-        violations = lint.run_lint()
+        changed_files = None
+        if args.changed:
+            changed_files = _git_changed_files()
+            if changed_files is None:
+                # git unavailable: degrade to the FULL lint, visibly —
+                # never pass a pre-commit hook by linting nothing.
+                print("graftcheck lint: --changed cannot reach git; "
+                      "falling back to a FULL lint", file=sys.stderr)
+            elif not changed_files:
+                print("graftcheck lint: no changed files vs merge-base — "
+                      "clean", file=sys.stderr)
+                return 0
+            else:
+                print(f"graftcheck lint: --changed scoping to "
+                      f"{len(changed_files)} file(s)", file=sys.stderr)
+        violations = lint.run_lint(files=changed_files)
         for v in violations:
             print(str(v), file=sys.stderr)
         n = len(violations)
@@ -166,15 +277,25 @@ def main(argv=None) -> int:
 
     if do_audit:
         budgets_path = args.budgets or hlo_audit.DEFAULT_BUDGETS_PATH
-        names = (
-            [a.strip() for a in args.arms.split(",") if a.strip()]
-            if args.arms else list(hlo_audit.ROSTER)
-        )
-        unknown = [n for n in names if n not in hlo_audit.ROSTER]
-        if unknown:
-            print(f"graftcheck: unknown arm(s) {unknown}; roster: "
-                  f"{list(hlo_audit.ROSTER)}", file=sys.stderr)
-            return 2
+        if args.arms:
+            requested = [a.strip() for a in args.arms.split(",") if a.strip()]
+            names = [n for n in requested if n in hlo_audit.ROSTER]
+            pipe_names = [
+                n for n in requested if n in hlo_audit.PIPELINE_ROSTER
+            ]
+            unknown = [
+                n for n in requested
+                if n not in hlo_audit.ROSTER
+                and n not in hlo_audit.PIPELINE_ROSTER
+            ]
+            if unknown:
+                print(f"graftcheck: unknown arm(s) {unknown}; roster: "
+                      f"{list(hlo_audit.ROSTER)} + pipeline roster: "
+                      f"{list(hlo_audit.PIPELINE_ROSTER)}", file=sys.stderr)
+                return 2
+        else:
+            names = list(hlo_audit.ROSTER)
+            pipe_names = list(hlo_audit.PIPELINE_ROSTER)
 
         import dataclasses as _dc
 
@@ -191,21 +312,50 @@ def main(argv=None) -> int:
                       f"{type(e).__name__}: {e}", file=sys.stderr)
                 return 2
 
+        pipe_results = []
+        for name in pipe_names:
+            spec = hlo_audit.PIPELINE_ROSTER[name]
+            if args.inject:
+                spec = _dc.replace(spec, inject=args.inject)
+            m2 = spec.grad_accum * hlo_audit.PIPELINE_GROWTH_M_FACTOR
+            print(f"graftcheck audit: lowering {name} (schedule laws, "
+                  f"M={spec.grad_accum} and M={m2}) ...", file=sys.stderr)
+            # Compile failures become schedule-compiles law findings
+            # (exit 1), not operational errors: these arms carry a known
+            # compile-failure history and the injection proof reverts
+            # exactly that fix.
+            pipe_results.append(hlo_audit.audit_pipeline_arm(spec))
+
         if args.json:
             import json as _json
 
-            print(_json.dumps(
-                {r.arm: r.to_budget_entry() for r in reports}, indent=2,
-                sort_keys=True,
-            ))
+            doc = {r.arm: r.to_budget_entry() for r in reports}
+            doc.update({
+                p.arm: (
+                    p.to_budget_entry() if p.compile_error is None
+                    else {"compile_error": p.compile_error}
+                )
+                for p in pipe_results
+            })
+            print(_json.dumps(doc, indent=2, sort_keys=True))
 
         if args.update_budgets:
             existing = None
             if os.path.exists(budgets_path):
                 existing = hlo_audit.load_budgets(budgets_path)
-            hlo_audit.write_budgets(reports, budgets_path, existing=existing)
-            print(f"graftcheck audit: froze {len(reports)} arm budget(s) "
-                  f"into {budgets_path}", file=sys.stderr)
+            if reports:
+                existing = hlo_audit.write_budgets(
+                    reports, budgets_path, existing=existing
+                )
+                print(f"graftcheck audit: froze {len(reports)} arm "
+                      f"budget(s) into {budgets_path}", file=sys.stderr)
+            if pipe_results:
+                hlo_audit.write_pipeline_budgets(
+                    pipe_results, budgets_path, existing=existing
+                )
+                print(f"graftcheck audit: froze {len(pipe_results)} "
+                      f"pipeline_schedules budget(s) into {budgets_path}",
+                      file=sys.stderr)
         else:
             if not os.path.exists(budgets_path):
                 print(f"graftcheck audit: no budgets file at {budgets_path} "
@@ -215,7 +365,9 @@ def main(argv=None) -> int:
             import jax
 
             frozen_on = budgets.get("jax_version")
-            if frozen_on is not None and frozen_on != jax.__version__:
+            if reports and frozen_on is not None and (
+                frozen_on != jax.__version__
+            ):
                 print(
                     f"graftcheck audit: budgets frozen on jax {frozen_on} "
                     f"but running jax {jax.__version__} — counts are not "
@@ -226,11 +378,30 @@ def main(argv=None) -> int:
             deltas = []
             for rep in reports:
                 deltas.extend(hlo_audit.diff_against_budget(rep, budgets))
+            if pipe_results:
+                pipe_frozen = budgets.get("pipeline_schedules", {}).get(
+                    "jax_version"
+                )
+                if pipe_frozen is not None and (
+                    pipe_frozen != jax.__version__
+                ):
+                    print(
+                        "graftcheck audit: pipeline_schedules budgets "
+                        f"frozen on jax {pipe_frozen} but running jax "
+                        f"{jax.__version__} — regenerate with "
+                        "--update-budgets", file=sys.stderr,
+                    )
+                    return 2
+                for p in pipe_results:
+                    deltas.extend(
+                        hlo_audit.diff_pipeline_against_budget(p, budgets)
+                    )
             for d in deltas:
                 print(f"graftcheck audit: {d}", file=sys.stderr)
             print(
-                f"graftcheck audit: {len(reports)} arm(s), "
-                f"{len(deltas)} budget delta(s)", file=sys.stderr,
+                f"graftcheck audit: {len(reports)} arm(s) + "
+                f"{len(pipe_results)} pipeline arm(s), "
+                f"{len(deltas)} finding(s)", file=sys.stderr,
             )
             failures += len(deltas)
 

@@ -40,7 +40,7 @@ COLLECTIVE_OPS = (
     "all-to-all",
 )
 
-_INJECTIONS = ("bad-kv-spec", "bad-fsdp-axis")
+_INJECTIONS = ("bad-kv-spec", "bad-fsdp-axis", "bad-pipeline-spec")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,7 +52,13 @@ class ArmSpec:
     ``inject`` deliberately reintroduces a known-bad configuration for
     self-tests — 'bad-kv-spec' disables the kv-head-aligned PartitionSpec
     rule, bringing back the GQA full-replicate resharding fallback PR 1
-    fixed (the auditor must flag it).
+    fixed (the auditor must flag it); 'bad-pipeline-spec' reverts the
+    typed-key/shard_map boundary fix, bringing back the seed-old u32
+    tile-assignment compile failure on the pipeline arms.
+
+    ``pipeline_schedule``/``virtual_stages`` only matter when the mesh
+    carries a >1 'pipe' axis (the schedule-auditor roster below); they
+    flow into ``train.step.abstract_compile_step`` unchanged.
     """
 
     name: str
@@ -66,6 +72,8 @@ class ArmSpec:
     grad_accum: int = 1
     config_overrides: Tuple[Tuple[str, Any], ...] = ()
     inject: Optional[str] = None
+    pipeline_schedule: str = "gpipe"
+    virtual_stages: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -229,12 +237,16 @@ def lower_arm(spec: ArmSpec, devices=None):
             cfg, strategy, mesh,
             grad_accum=spec.grad_accum, seed=0, from_table=False,
             global_micro=spec.global_batch, seq_len=spec.seq_len,
+            pipeline_schedule=spec.pipeline_schedule,
+            virtual_stages=spec.virtual_stages,
         )
 
     if spec.inject == "bad-kv-spec":
         return _with_bad_kv_spec(compile_)
     if spec.inject == "bad-fsdp-axis":
         return _with_bad_fsdp_axis(compile_)
+    if spec.inject == "bad-pipeline-spec":
+        return _with_bad_pipeline_spec(compile_)
     return compile_()
 
 
@@ -281,6 +293,29 @@ def _with_bad_fsdp_axis(fn):
         return fn()
     finally:
         strat._COMPOSED_FSDP_HYGIENE = True
+
+
+def _with_bad_pipeline_spec(fn):
+    """Run ``fn`` with the pipeline typed-key boundary fix reverted.
+
+    ``parallel.pipeline._key_data_or_none`` exists because a typed PRNG
+    key must cross the pipeline shard_map boundary as raw u32 key data —
+    passing the key itself resurrects the seed-old interleaved compile
+    failure (the partial-auto boundary builds a rank-0 sharding for the
+    key aval and XLA rejects it against the rank-1 physical u32 data:
+    "Number of tile assignment dimensions ... is different than the input
+    rank ... u32[...]"). The pipeline roster arms audit with live dropout
+    keys precisely so this injection makes them fail to compile, and the
+    schedule auditor must then exit 1 naming the arm and the
+    schedule-compiles law.
+    """
+    from ...parallel import pipeline as pl
+
+    pl._TYPED_KEY_BOUNDARY_FIX = False
+    try:
+        return fn()
+    finally:
+        pl._TYPED_KEY_BOUNDARY_FIX = True
 
 
 # One instruction definition per line: "%name = <shape> <opcode>(...". The
@@ -344,6 +379,360 @@ def audit_arm(spec: ArmSpec, devices=None) -> ArmReport:
 
 
 # ---------------------------------------------------------------------------
+# Pipeline schedule auditor: closed-form send/recv + bubble laws
+# ---------------------------------------------------------------------------
+
+#: Pipeline arms in the audited roster — the suite's pp compositions
+#: (scripts/run_all_benchmarks.sh pp2-{gpipe,1f1b,interleaved}) at the
+#: interleaved-CLI mesh shape (dp=2 x pipe=2, 4 of the 8 virtual
+#: devices), plus a llama-family composition so the GQA blocks audit
+#: under pipeline layer sharding too. Unlike the CPU arm roster these
+#: lower WITH live dropout keys (``dropout`` pinned to the family
+#: default instead of the roster's dropout-free choice): the typed-key
+#: shard_map boundary was the seed-old interleaved compile failure, and
+#: an audit that DCEs the keys away could never catch its return —
+#: ``--inject bad-pipeline-spec`` reverts exactly that fix. Dropout adds
+#: RNG ops but no collectives, so the pinned schedule stays
+#: deterministic. The interleaved arm runs V=2 real virtual chunks
+#: (n_layer=4) so the audit covers actual interleaving, not the V=1
+#: degenerate shape.
+PIPELINE_ROSTER: Dict[str, ArmSpec] = {
+    spec.name: spec
+    for spec in (
+        ArmSpec(
+            "pp2-gpipe", "ddp", (2, 1, 1, 2),
+            ("data", "seq", "model", "pipe"),
+            global_batch=4, grad_accum=4, pipeline_schedule="gpipe",
+            config_overrides=(("dropout", 0.1),),
+        ),
+        ArmSpec(
+            "pp2-1f1b", "ddp", (2, 1, 1, 2),
+            ("data", "seq", "model", "pipe"),
+            global_batch=4, grad_accum=4, pipeline_schedule="1f1b",
+            config_overrides=(("dropout", 0.1),),
+        ),
+        ArmSpec(
+            "pp2-interleaved-v2", "ddp", (2, 1, 1, 2),
+            ("data", "seq", "model", "pipe"),
+            global_batch=4, grad_accum=4, pipeline_schedule="interleaved",
+            virtual_stages=2,
+            config_overrides=(("dropout", 0.1), ("n_layer", 4)),
+        ),
+        ArmSpec(
+            "llama-pp2-1f1b", "ddp", (2, 1, 1, 2),
+            ("data", "seq", "model", "pipe"),
+            global_batch=4, grad_accum=4, model_family="llama",
+            pipeline_schedule="1f1b",
+            config_overrides=(("dropout", 0.1),),
+        ),
+    )
+}
+
+#: Second microbatch count each pipeline arm is audited at: the growth
+#: law needs two M points to verdict the affine-in-M shape.
+PIPELINE_GROWTH_M_FACTOR = 2
+
+
+def expected_pipeline_permutes(
+    schedule: str, stages: int, microbatches: int, virtual: int = 1
+) -> int:
+    """Closed-form collective-permute count of the compiled step.
+
+    Counts are HLO *instructions* in the lowered module, which is what
+    :func:`count_collectives` measures — each instruction moves every
+    stage's current payload one ring hop, so the per-direction data
+    movement (e.g. GPipe forward: M*(S-1) stage-to-stage sends) rides
+    fewer instructions than sends:
+
+    - **gpipe**: the Python tick loop unrolls — forward emits ticks-1 =
+      M+S-2 ppermutes and ``jax.value_and_grad`` transposes each for the
+      backward: 2*(M+S-2). Affine in M, slope 2.
+    - **1f1b**: hand-scheduled — M+S-2 forward-ring + M+S-2
+      backward-ring instructions: 2*(M+S-2). Affine in M, slope 2.
+    - **interleaved**: the executor replays the schedule tables with ONE
+      ``lax.scan`` tick body holding exactly one fwd-ring and one
+      bwd-ring ppermute — 2 instructions regardless of M (the tick count
+      lives in the scan trip count, not the instruction count). Slope 0.
+    """
+    S, M = stages, microbatches
+    if schedule == "gpipe":
+        return 2 * (M + S - 2)
+    if schedule == "1f1b":
+        return 2 * (M + S - 2)
+    if schedule == "interleaved":
+        return 2
+    raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+
+def pipeline_permute_slope(schedule: str) -> int:
+    """d(collective-permute instructions)/dM for the affine growth law."""
+    return 0 if schedule == "interleaved" else 2
+
+
+def pipeline_bubble_bound(
+    schedule: str, stages: int, microbatches: int, virtual: int = 1
+) -> float:
+    """Structural bubble-fraction upper bound for one schedule.
+
+    The fraction of schedule capacity the fill/drain ramps waste —
+    trace-measured ``bubble_frac`` (step-anatomy device idle) must not
+    exceed this plus measurement slack; exceeding it means the executed
+    overlap does NOT match the schedule's structure (an
+    anatomy/structure mismatch, not noise):
+
+    - **gpipe**: (S-1)/(M+S-1) for each of the forward and transposed
+      backward phases — the classic fill/drain ratio.
+    - **1f1b (lockstep)**: fill+drain are 2(S-1) of the M+2(S-1) ticks,
+      each tick holding up to one fwd and one bwd unit:
+      2(S-1)/(M+2(S-1)).
+    - **interleaved**: the exact idle fraction of the (ticks x P) unit
+      grid from the real scheduler tables
+      (``parallel.interleaved.build_schedule().bubble_fraction``) — the
+      v*S-aware variant, tighter than any closed form because the greedy
+      scheduler's concrete tick count is known.
+    """
+    S, M = stages, microbatches
+    if schedule == "gpipe":
+        return (S - 1) / (M + S - 1)
+    if schedule == "1f1b":
+        return 2 * (S - 1) / (M + 2 * (S - 1))
+    if schedule == "interleaved":
+        from ...parallel.interleaved import build_schedule
+
+        return float(build_schedule(S, virtual, M).bubble_fraction)
+    raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineAuditResult:
+    """One pipeline arm's audit: counts at two M values + the law inputs.
+
+    ``compile_error`` set (and both reports None) when the arm failed to
+    lower — for pipeline arms that is a FINDING (the schedule-compiles
+    law), not an operational error: these arms have a known compile-
+    failure history (the seed-old interleaved bug) and the injection
+    proof reverts exactly that fix.
+    """
+
+    arm: str
+    schedule: str
+    stages: int
+    microbatches: int
+    virtual: int
+    grown_microbatches: int
+    base: Optional[ArmReport] = None
+    grown: Optional[ArmReport] = None
+    compile_error: Optional[str] = None
+
+    def to_budget_entry(self) -> Dict[str, Any]:
+        assert self.base is not None and self.grown is not None
+        return {
+            "schedule": {
+                "schedule": self.schedule,
+                "stages": self.stages,
+                "microbatches": self.microbatches,
+                "virtual": self.virtual,
+                "grown_microbatches": self.grown_microbatches,
+                "expected_collective_permutes": expected_pipeline_permutes(
+                    self.schedule, self.stages, self.microbatches,
+                    self.virtual,
+                ),
+                "bubble_frac_bound": round(pipeline_bubble_bound(
+                    self.schedule, self.stages, self.microbatches,
+                    self.virtual,
+                ), 6),
+            },
+            "base": self.base.to_budget_entry(),
+            "grown": self.grown.to_budget_entry(),
+        }
+
+
+def audit_pipeline_arm(
+    spec: ArmSpec, devices=None
+) -> PipelineAuditResult:
+    """Audit one pipeline arm at its roster M and at M*growth-factor.
+
+    The (S, M, V) law inputs mirror ``train.step.pipeline_schedule_meta``
+    (M == grad_accum — the step feeds its whole accumulation axis to the
+    schedule); a test pins the two against each other so the laws cannot
+    drift from what the step compiles.
+    """
+    pipe = dict(zip(spec.axes, spec.mesh_shape)).get("pipe", 1)
+    if pipe <= 1:
+        raise ValueError(
+            f"arm {spec.name!r} has no >1 'pipe' axis — not a pipeline arm"
+        )
+    m2 = spec.grad_accum * PIPELINE_GROWTH_M_FACTOR
+    meta = {
+        "schedule": spec.pipeline_schedule,
+        "stages": pipe,
+        "microbatches": spec.grad_accum,
+        "virtual": (
+            spec.virtual_stages
+            if spec.pipeline_schedule == "interleaved" else 1
+        ),
+    }
+    try:
+        base = audit_arm(spec, devices=devices)
+        grown = audit_arm(
+            dataclasses.replace(spec, grad_accum=m2), devices=devices
+        )
+    except Exception as e:
+        msg = f"{type(e).__name__}: {e}"
+        return PipelineAuditResult(
+            arm=spec.name, grown_microbatches=m2,
+            compile_error=msg[:500], **meta,
+        )
+    return PipelineAuditResult(
+        arm=spec.name, grown_microbatches=m2, base=base, grown=grown,
+        **meta,
+    )
+
+
+def pipeline_law_findings(result: PipelineAuditResult) -> List[str]:
+    """The schedule laws, each named per arm + law when broken.
+
+    - **schedule-compiles**: the arm must lower at all (the seed-old
+      interleaved bug class; what ``--inject bad-pipeline-spec``
+      resurrects).
+    - **permute-law**: collective-permute instructions must equal the
+      closed form at BOTH audited M values — the excess is the pipeline
+      analogue of a replication-reshard suspect (GSPMD resharding the
+      manual region's operands lowers as extra permute chains).
+    - **affine-growth**: the count must grow affinely in M with the
+      schedule's slope (2 for the unrolled tick loops, 0 for the
+      scanned interleaved executor) — a superlinear term means
+      per-microbatch resharding.
+    """
+    arm, sched = result.arm, result.schedule
+    if result.compile_error is not None:
+        return [
+            f"schedule-law: {arm} VIOLATES schedule-compiles "
+            f"[{sched} S={result.stages} M={result.microbatches} "
+            f"V={result.virtual}]: {result.compile_error}"
+        ]
+    findings: List[str] = []
+    for label, rep, m in (
+        ("base", result.base, result.microbatches),
+        ("grown", result.grown, result.grown_microbatches),
+    ):
+        want = expected_pipeline_permutes(
+            sched, result.stages, m, result.virtual
+        )
+        got = rep.collectives.get("collective-permute", 0)
+        if got != want:
+            findings.append(
+                f"schedule-law: {arm} VIOLATES permute-law at {label} "
+                f"M={m}: {got} collective-permutes != closed-form {want} "
+                f"for {sched}(S={result.stages}, V={result.virtual}) — "
+                f"{max(got - want, 0)} excess permute(s) are pipeline "
+                "reshard suspects"
+            )
+    d_got = (
+        result.grown.collectives.get("collective-permute", 0)
+        - result.base.collectives.get("collective-permute", 0)
+    )
+    d_m = result.grown_microbatches - result.microbatches
+    slope = pipeline_permute_slope(sched)
+    if d_got != slope * d_m:
+        findings.append(
+            f"schedule-law: {arm} VIOLATES affine-growth: permutes grew "
+            f"{d_got:+d} over {d_m:+d} microbatches (expected slope "
+            f"{slope}/microbatch for {sched})"
+        )
+    return findings
+
+
+def write_pipeline_budgets(
+    results: List[PipelineAuditResult],
+    path: str = DEFAULT_BUDGETS_PATH,
+    existing: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Freeze pipeline-arm budgets into the ``pipeline_schedules`` section.
+
+    Merges over the existing document — the CPU arm roster and the
+    topology tiers pass through byte-unchanged, mirroring
+    :func:`write_budgets` / :func:`write_topology_budgets`.
+    """
+    import jax
+
+    failed = [r.arm for r in results if r.compile_error is not None]
+    if failed:
+        raise ValueError(
+            "refusing to freeze pipeline budgets with arms that failed "
+            f"to compile: {failed}"
+        )
+    doc = (
+        dict(existing) if existing is not None
+        else (load_budgets(path) if os.path.exists(path) else {"arms": {}})
+    )
+    section = dict(doc.get("pipeline_schedules", {}))
+    arms = dict(section.get("arms", {}))
+    frozen = section.get("jax_version")
+    if frozen is not None and frozen != jax.__version__:
+        # Same refusal as write_budgets: merging fresh counts over arms
+        # frozen on a different jax and restamping the section's version
+        # would claim incomparable counts are commensurable.
+        regenerated = {r.arm for r in results}
+        stale = set(arms) - regenerated
+        if stale:
+            raise ValueError(
+                f"pipeline_schedules budgets were frozen on jax {frozen} "
+                f"but this is jax {jax.__version__}: a partial --arms "
+                "regeneration would mix incomparable counts — regenerate "
+                f"the full pipeline roster (missing: {sorted(stale)})"
+            )
+        arms = {}
+    for r in results:
+        arms[r.arm] = r.to_budget_entry()
+    doc["pipeline_schedules"] = {
+        "jax_version": jax.__version__,
+        "arms": arms,
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def diff_pipeline_against_budget(
+    result: PipelineAuditResult, budgets: Dict[str, Any]
+) -> List[str]:
+    """Law findings + exact-pin diffs for one pipeline arm.
+
+    The laws run unconditionally (they need no frozen state); the pins
+    then hold the full collective/donation/convert profile at both M
+    values against the frozen ``pipeline_schedules`` budgets, so even a
+    law-respecting drift (e.g. +2 all-reduces) fails loudly.
+    """
+    findings = pipeline_law_findings(result)
+    if result.compile_error is not None:
+        return findings
+    section = budgets.get("pipeline_schedules", {})
+    arm_budget = section.get("arms", {}).get(result.arm)
+    if arm_budget is None:
+        return findings + [
+            f"{result.arm}: no frozen pipeline_schedules budget for this "
+            "arm (run --update-budgets to freeze one)"
+        ]
+    frozen_meta = dict(arm_budget.get("schedule", {}))
+    live_meta = result.to_budget_entry()["schedule"]
+    if frozen_meta != live_meta:
+        findings.append(
+            f"{result.arm}: schedule metadata drifted from the frozen "
+            f"budget ({frozen_meta} != {live_meta}) — regenerate with "
+            "--update-budgets and review"
+        )
+    for label, rep in (("base", result.base), ("grown", result.grown)):
+        scoped = {"arms": {result.arm: arm_budget.get(label, {})}}
+        findings.extend(
+            f"{label}: {d}" for d in diff_against_budget(rep, scoped)
+        )
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Topology tiers: AOT audits of pod-scale meshes on the CPU host
 # ---------------------------------------------------------------------------
 
@@ -379,7 +768,11 @@ TOPOLOGY_TIERS: Dict[str, TopologyTier] = {
 #: Roster arms audited per tier — the scalable subset: each scales its
 #: 'data' axis (and global batch with it) to fill the tier's device
 #: count, so the growth laws below have one well-defined growing axis.
-TOPOLOGY_ARMS = ("zero2-dp8", "fsdp-dp8", "llama-tp2-gqa")
+#: ``pp2-gpipe`` (from PIPELINE_ROSTER) brings a pipeline composition
+#: under the per-tier budgets: its pipe degree is identity, the data
+#: axis absorbs the tier, and its ring-permute count must stay CONSTANT
+#: as data grows (the growth laws' at-most-linear bound covers it).
+TOPOLOGY_ARMS = ("zero2-dp8", "fsdp-dp8", "llama-tp2-gqa", "pp2-gpipe")
 
 #: Tiers ``graftcheck --all`` audits by default. v5e-256 compiles in
 #: ~40s+ per arm on a small host — audit it explicitly with
@@ -498,7 +891,10 @@ def audit_topology_tier(
     devices = topology_devices(tier)
     reports: List[ArmReport] = []
     for name in arm_names or TOPOLOGY_ARMS:
-        spec = ROSTER[name]
+        # Pipeline compositions live in their own roster; per-tier they
+        # audit as plain count pins (the dual-M schedule laws run on the
+        # CPU roster — the tier audit pins the at-scale lowering).
+        spec = ROSTER.get(name) or PIPELINE_ROSTER[name]
         scaled = scale_spec_to_devices(spec, tier.device_count)
         if inject:
             scaled = dataclasses.replace(scaled, inject=inject)
@@ -708,6 +1104,10 @@ def write_budgets(
         # (write_topology_budgets); an arm-roster regeneration must carry
         # them through untouched, not silently drop a whole section.
         doc["topology_tiers"] = existing["topology_tiers"]
+    if existing is not None and existing.get("pipeline_schedules"):
+        # Same carry-through contract for the pipeline-schedule budgets
+        # (frozen by write_pipeline_budgets).
+        doc["pipeline_schedules"] = existing["pipeline_schedules"]
     if existing is not None:
         # A partial regeneration on a different jax than the file was
         # frozen on would mix incomparable counts — and silently dropping

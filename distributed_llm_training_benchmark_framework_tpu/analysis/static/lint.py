@@ -41,6 +41,12 @@ deployment invariant this codebase has already paid for once:
          axis outside the site's fully-literal ``axis_names`` set: the
          bad axis only raises at trace time, deep inside a jit. Sites
          whose axis set is not fully static are skipped, never guessed.
+- GC109  ``with_sharding_constraint``/``device_put``/host-sync calls
+         inside a per-microbatch Python loop (``for _ in range(...)``)
+         in ``parallel/``: the pipeline tick loops unroll at trace time,
+         so one such call becomes M per-microbatch reshards (or M device
+         fences) in the compiled step — the per-microbatch reshard
+         hazard the schedule auditor's growth laws exist to catch.
 - GC201  entrypoint<->harness flag-surface drift (PR 1's detector, now a
          registry rule): every ``train/harness.py`` flag must be reachable
          from the container env in ``docker/entrypoint.sh`` and vice versa.
@@ -814,6 +820,102 @@ def _check_shard_map_collective_axes(root: str) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GC109: per-microbatch reshard hazard in parallel/ schedule loops
+# ---------------------------------------------------------------------------
+
+#: Calls that re-place or re-lay-out device values: one of these inside a
+#: trace-time-unrolled schedule loop becomes M copies in the compiled step.
+_GC109_RESHARD_CALLS = frozenset({
+    "with_sharding_constraint", "lax.with_sharding_constraint",
+    "jax.lax.with_sharding_constraint",
+    "device_put", "jax.device_put",
+})
+#: Host-synchronizing calls (the GC102 classes, scoped to parallel/):
+#: inside a schedule loop each unrolled copy fences the device.
+_GC109_HOST_SYNC_CALLS = frozenset({
+    "np.asarray", "numpy.asarray", "np.array", "jax.device_get",
+})
+
+
+def _gc109_classify(call: ast.Call, traced_loop: bool) -> Optional[str]:
+    name = _dotted(call.func)
+    if name in _GC109_RESHARD_CALLS:
+        return f"{name}(...) re-places/re-lays-out a value"
+    if not traced_loop:
+        # Host-sync classes only matter in loops that touch jax at all:
+        # the schedule BUILDERS (build_schedule's numpy/heapq passes) are
+        # pure host code where int()/np.asarray are innocent — flagging
+        # them would force disable= pragmas onto correct code.
+        return None
+    if name in _GC109_HOST_SYNC_CALLS:
+        return f"{name}(...) is a device->host transfer"
+    if name in ("float", "int") and call.args:
+        return f"{name}(...) is a .item()-class host sync"
+    if isinstance(call.func, ast.Attribute) and call.func.attr in (
+        "item", "block_until_ready"
+    ):
+        return f".{call.func.attr}() is a host sync"
+    return None
+
+
+def _loop_touches_jax(loop: ast.For) -> bool:
+    """True when the loop subtree references jax/jnp/lax names — the
+    trace-time-unrolled shape GC109's host-sync classes police."""
+    for n in ast.walk(loop):
+        name = _dotted(n) if isinstance(n, (ast.Attribute, ast.Name)) else None
+        if name and name.split(".", 1)[0] in ("jax", "jnp", "lax"):
+            return True
+    return False
+
+
+@_rule(
+    "GC109",
+    "per-microbatch-reshard-hazard-in-schedule-loop",
+    "with_sharding_constraint/device_put/host-sync call inside a "
+    "`for _ in range(...)` loop body in parallel/ — schedule loops unroll "
+    "at trace time, so the call becomes one reshard/fence PER MICROBATCH "
+    "in the compiled step (the growth the schedule auditor's affine law "
+    "flags as pipeline reshard suspects)",
+    "hoist the placement to the shard_map boundary (in_specs/out_specs or "
+    "a single constraint outside the loop); derive per-tick values from "
+    "sharded operands instead of host syncs; suppress deliberate "
+    "exceptions with '# graftcheck: disable=GC109'",
+)
+def _check_schedule_loop_reshards(root: str) -> Iterator[Violation]:
+    for tree in _package_files(root, ("parallel",)):
+        seen = set()  # nested range loops would double-report inner calls
+        for node in ast.walk(tree.ast):
+            if not (
+                isinstance(node, ast.For)
+                and isinstance(node.iter, ast.Call)
+                and _dotted(node.iter.func) == "range"
+            ):
+                continue
+            traced = _loop_touches_jax(node)
+            # Full subtree walk, INCLUDING nested function defs (unlike
+            # _stmt_calls): the real tick loops put per-tick work in
+            # closures invoked via lax.cond/switch each unrolled tick, so
+            # a hazard inside one is still one copy per microbatch.
+            for stmt in node.body + node.orelse:
+                for call in ast.walk(stmt):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    kind = _gc109_classify(call, traced)
+                    if (
+                        kind
+                        and (call.lineno, call.col_offset) not in seen
+                        and not _suppressed(tree, call.lineno, "GC109")
+                    ):
+                        seen.add((call.lineno, call.col_offset))
+                        yield Violation(
+                            "GC109", tree.rel, call.lineno,
+                            f"{kind} inside a range() schedule loop "
+                            "(unrolls per microbatch at trace time)",
+                            RULES["GC109"].fix_hint,
+                        )
+
+
+# ---------------------------------------------------------------------------
 # GC201: entrypoint <-> harness flag-surface drift
 # ---------------------------------------------------------------------------
 
@@ -867,12 +969,24 @@ def _check_entrypoint_drift(root: str) -> Iterator[Violation]:
 
 
 def run_lint(
-    root: str = REPO_ROOT, rules: Optional[Tuple[str, ...]] = None
+    root: str = REPO_ROOT,
+    rules: Optional[Tuple[str, ...]] = None,
+    files: Optional[Tuple[str, ...]] = None,
 ) -> List[Violation]:
-    """Run every registered rule (or the named subset) over ``root``."""
+    """Run every registered rule (or the named subset) over ``root``.
+
+    ``files`` (repo-relative paths) scopes the REPORT to those files —
+    the `--changed` pre-commit path. Rules still scan the whole package
+    for their knowledge bases (GC103's mesh-axis harvest, GC201's flag
+    surfaces), so a changed file is judged against unchanged context; a
+    violation is only emitted when it sits in a changed file.
+    """
     out: List[Violation] = []
     for rule, check in _CHECKS:
         if rules is not None and rule.id not in rules:
             continue
         out.extend(v for v in check(root) if v is not None)
+    if files is not None:
+        wanted = {f.replace(os.sep, "/") for f in files}
+        out = [v for v in out if v.path.replace(os.sep, "/") in wanted]
     return sorted(out, key=lambda v: (v.path, v.line, v.rule_id))

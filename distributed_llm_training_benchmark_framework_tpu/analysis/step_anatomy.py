@@ -68,6 +68,12 @@ from . import profile_summary as ps
 
 COST_JSON_FILENAME = "cost_analysis.json"
 
+#: Absolute slack (fraction of step time) the measured bubble may exceed
+#: the schedule's structural bound before the anatomy/structure mismatch
+#: finding fires: trace idle includes host dispatch gaps the schedule
+#: grid does not model, so a tight-to-the-bound run is healthy.
+BUBBLE_BOUND_SLACK = 0.10
+
 #: XLA collective-op name patterns. Substring match on the op/base name for
 #: the unambiguous collective families; ``send``/``recv`` (pipeline
 #: transfers) only as a leading token so e.g. a custom-call mentioning
@@ -554,6 +560,44 @@ def analyze_profile_dir(
         ),
     }
 
+    # Schedule-auditor cross-check: the measured bubble must not exceed
+    # the schedule's STRUCTURAL bound (the graftcheck closed forms /
+    # scheduler tables — analysis.static.hlo_audit.pipeline_bubble_bound)
+    # plus measurement slack. Exceeding it is not noise: the executed
+    # overlap does not match the schedule's structure, which is exactly
+    # the regression an unaudited schedule would hide. Only computed when
+    # the run's telemetry carries the (S, M, V) inputs; old traces
+    # without them keep bubble_frac un-verdicted.
+    agg["bubble_frac_bound"] = None
+    agg["bubble_structure_mismatch"] = False
+    if agg["bubble_frac"] is not None:
+        s_stages = int(meta.get("pipeline_parallel", 0) or 0)
+        m_micro = int(meta.get("grad_accum", 0) or 0)
+        v_chunks = int(meta.get("virtual_stages", 1) or 1)
+        if (
+            agg["pipeline_schedule"] == "interleaved"
+            and "virtual_stages" not in meta
+        ):
+            # Interleaved bounds NEED the real V (interleaving shrinks
+            # the bubble, so a defaulted V=1 bound would be silently
+            # loose); pre-schedule-auditor traces never recorded it —
+            # leave those un-verdicted rather than mis-bounded.
+            s_stages = 0
+        if s_stages > 1 and m_micro > 0:
+            from .static.hlo_audit import pipeline_bubble_bound
+
+            try:
+                bound = pipeline_bubble_bound(
+                    agg["pipeline_schedule"], s_stages, m_micro, v_chunks
+                )
+            except ValueError:
+                bound = None
+            if bound is not None:
+                agg["bubble_frac_bound"] = round(bound, 6)
+                agg["bubble_structure_mismatch"] = bool(
+                    agg["bubble_frac"] > bound + BUBBLE_BOUND_SLACK
+                )
+
     roofline: Optional[Dict[str, Any]] = None
     if cost and agg["median_step_us"] > 0:
         from ..utils import platform as platform_mod
@@ -705,10 +749,26 @@ def format_report(report: Dict[str, Any]) -> str:
         )
         out.append(f"  exposed by class (per step): {byc}")
     if agg["bubble_frac"] is not None:
-        out.append(
+        line = (
             f"  bubble fraction ({agg['pipeline_schedule']}): "
             f"{100.0 * agg['bubble_frac']:.1f}%"
         )
+        if agg.get("bubble_frac_bound") is not None:
+            line += (
+                f" (structural bound "
+                f"{100.0 * agg['bubble_frac_bound']:.1f}%)"
+            )
+        out.append(line)
+        if agg.get("bubble_structure_mismatch"):
+            out.append(
+                "  ANATOMY/STRUCTURE MISMATCH: measured bubble "
+                f"{100.0 * agg['bubble_frac']:.1f}% exceeds the "
+                f"{agg['pipeline_schedule']} schedule's structural bound "
+                f"{100.0 * agg['bubble_frac_bound']:.1f}% + "
+                f"{100.0 * BUBBLE_BOUND_SLACK:.0f}pp slack — the executed "
+                "overlap does not match the schedule (not noise; see "
+                "docs/STATIC_ANALYSIS.md schedule auditor)"
+            )
     if agg["straggler_skew_pct"] is not None:
         out.append(
             f"  straggler skew: {agg['straggler_skew_pct']:.1f}% across "

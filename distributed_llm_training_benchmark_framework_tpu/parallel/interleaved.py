@@ -82,7 +82,10 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models import tinygpt
-from .pipeline import AXIS, pipeline_param_specs, _seq_setup
+from .pipeline import (
+    AXIS, _key_data_or_none, _rebuild_key, _seq_setup, _stage_iota,
+    pipeline_param_specs,
+)
 
 IDLE, FWD, BWD = 0, 1, 2
 
@@ -317,7 +320,9 @@ def interleaved_loss_and_grads(
             f"n_layer={config.n_layer} not divisible by pipe*virtual="
             f"{n_stages}*{V}"
         )
-    config, seq_ax, sp, manual_axes, batch_spec = _seq_setup(config, mesh)
+    config, seq_ax, sp, data_ax, dp, manual_axes, batch_spec = _seq_setup(
+        config, mesh
+    )
     # See the module docstring: XLA:CPU's collective rendezvous spans all
     # local devices per instruction, so 'seq' collectives inside the
     # device-varying switch deadlock there. Run all unit kinds and mask.
@@ -331,12 +336,33 @@ def interleaved_loss_and_grads(
     sched = build_schedule(n_stages, V, n_micro)
     perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
     perm_bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
-    inv_m = 1.0 / n_micro
+    # Mean over microbatches AND manual data shards (dp=1 when 'data' is
+    # auto); the hand-seeded loss cotangent uses the same scale.
+    inv_m = 1.0 / (n_micro * dp)
     var_axes = (AXIS,) + ((seq_ax,) if seq_ax else ())
-    moe = config.n_experts > 0
+    # Scalar (loss/aux) reductions: CE/aux are already seq-invariant when
+    # sp>1 (psum'd inside), so they span pipe + the manual data axis.
+    reduce_axes = (AXIS,) + ((data_ax,) if data_ax else ())
+    # Parameter-grad reductions: var_axes plus the manual data axis (on
+    # vma runtimes data stays auto and this equals var_axes exactly).
+    grad_axes = var_axes + ((data_ax,) if data_ax else ())
+    # Legacy cotangent-seed scale — pre-vma jax transposes psum to psum,
+    # so differentiating through the CE/aux internal 'seq' psum inflates a
+    # hand-seeded cotangent by sp; seed 1/sp to cancel (the explicit
+    # grad psums below restore the cross-shard sums). See the identical
+    # note in pipeline.pipeline_loss_and_grads_1f1b.
+    from .pipeline import _legacy_partial_auto
 
-    def staged(params, batch):
-        stage = lax.axis_index(AXIS)
+    ct_scale = 1.0 / sp if (_legacy_partial_auto() and sp > 1) else 1.0
+    moe = config.n_experts > 0
+    key_data = _key_data_or_none(base_key)
+
+    def staged(params, batch, stage_arr):
+        stage = stage_arr[0]
+        # The typed key must not cross the shard_map boundary (the seed-old
+        # u32 tile-assignment compile failure — see _key_data_or_none);
+        # rebuild it from the raw data inside the manual region.
+        base_key = _rebuild_key(key_data)
         blocks = params["blocks"]  # local rows: V chunks x Lc layers
         mb, S = batch.shape[1], batch.shape[2]
         D = config.n_embd
@@ -371,7 +397,8 @@ def interleaved_loss_and_grads(
         # needed (unlike the lockstep schedules' fill/drain ticks).
         aux_sum = var_p(jnp.zeros((), jnp.float32))
         aux_ct_const = (
-            config.router_aux_coef / (config.n_layer * n_micro) if moe else 0.0
+            config.router_aux_coef * ct_scale / (config.n_layer * n_micro * dp)
+            if moe else 0.0
         )
 
         hp = {k: params[k] for k in tinygpt.head_param_names(config)}
@@ -508,7 +535,7 @@ def interleaved_loss_and_grads(
                         )
                         return l, aux
                     (l, aux_p), vjp = jax.vjp(fn, blk_c, hp_in, x_saved)
-                    dl = var_p(jnp.asarray(inv_m, jnp.float32))
+                    dl = var_p(jnp.asarray(inv_m * ct_scale, jnp.float32))
                     d_blk, d_hp_t, d_x = vjp(
                         (dl, jnp.zeros_like(aux_p) + aux_ct_const)
                     )
@@ -591,16 +618,27 @@ def interleaved_loss_and_grads(
         carry, _ = lax.scan(tick, carry, xs)
 
         (_, _, _, _, _, d_blocks, d_hp, d_ep, loss_sum, aux_sum) = carry
-        loss = lax.psum(loss_sum, AXIS) * inv_m
+        loss = lax.psum(loss_sum, reduce_axes) * inv_m
         if moe:
             # Every (microbatch, chunk) contributed its layers' aux exactly
             # once; normalize as gpipe/1f1b do: coef * mean per layer per
-            # microbatch.
+            # microbatch (averaged over manual data shards when present).
             loss = loss + config.router_aux_coef * lax.psum(
-                aux_sum, AXIS
-            ) / (config.n_layer * n_micro)
-        d_hp = jax.tree.map(lambda x: lax.psum(x, var_axes), d_hp)
-        d_ep = jax.tree.map(lambda x: lax.psum(x, var_axes), d_ep)
+                aux_sum, reduce_axes
+            ) / (config.n_layer * n_micro * dp)
+        d_hp = jax.tree.map(lambda x: lax.psum(x, grad_axes), d_hp)
+        d_ep = jax.tree.map(lambda x: lax.psum(x, grad_axes), d_ep)
+        blk_axes = tuple(
+            a for a in (data_ax, seq_ax if ct_scale != 1.0 else None) if a
+        )
+        if blk_axes:
+            # Block grads stay per-stage (out_spec P('pipe', ...)) but sum
+            # across the manual data shards' local batches — and across
+            # 'seq' on the legacy runtime, where the 1/sp-scaled seeds
+            # leave per-shard partials (vma runtimes reduce implicitly).
+            d_blocks = jax.tree.map(
+                lambda x: lax.psum(x, blk_axes), d_blocks
+            )
         grads = {"blocks": d_blocks}
         for _dtree in (d_hp, d_ep):  # wte appears in both when tied: sum
             for _k, _v in _dtree.items():
@@ -611,8 +649,8 @@ def interleaved_loss_and_grads(
     fn = jax.shard_map(
         staged,
         mesh=mesh,
-        in_specs=(specs, batch_spec),
+        in_specs=(specs, batch_spec, P(AXIS)),
         out_specs=(P(), specs),
         axis_names=manual_axes,
     )
-    return fn(params, batch)
+    return fn(params, batch, _stage_iota(n_stages))

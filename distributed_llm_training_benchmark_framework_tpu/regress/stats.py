@@ -92,6 +92,13 @@ SECONDARY_METRICS = (
     # regression even when the ws=8 absolute throughput sits inside its
     # own noise floor) — the arm slug names the geometry in the gate line.
     ("scaling_efficiency", True, 2.0, "abs_pp"),
+    # Pipeline-arm bubble fraction (step-anatomy device idle — only
+    # pipeline rows carry it, others skip via the both-rows-present
+    # rule). Absolute pp scale like comms_exposed_frac: a schedule whose
+    # bubble grew 2pp regressed even when wall-clock noise hides it —
+    # the dynamic half of the schedule auditor's structural bubble
+    # bound (docs/STATIC_ANALYSIS.md).
+    ("bubble_frac", False, 2.0, "abs_pp"),
 )
 #: Absolute-scale fallback noise floor (percentage points) below 3
 #: same-config history runs.

@@ -172,6 +172,18 @@ def _make_recorder(kwargs: dict) -> TelemetryRecorder:
                 "sequence_parallel": int(kwargs.get("sequence_parallel", 1)),
                 "pipeline_parallel": int(kwargs.get("pipeline_parallel", 1)),
                 "pipeline_schedule": kwargs.get("pipeline_schedule", "gpipe"),
+                # The step-anatomy bubble cross-check needs V to derive the
+                # interleaved schedule's structural bound from the trace;
+                # effective value (only interleaved runs virtual chunks).
+                # The omitted-kwarg default MUST match _run_benchmark_impl's
+                # signature default (2) or the recorded V lies about the
+                # compiled schedule and the bound goes silently loose.
+                "virtual_stages": (
+                    int(kwargs.get("virtual_stages", 2))
+                    if int(kwargs.get("pipeline_parallel", 1)) > 1
+                    and kwargs.get("pipeline_schedule") == "interleaved"
+                    else 1
+                ),
                 "expert_parallel": int(kwargs.get("expert_parallel", 1)),
                 "n_experts": int(kwargs.get("n_experts", 0)),
                 "causal": bool(kwargs.get("causal", False)),

@@ -138,6 +138,39 @@ def zero2_block_grad_spec(
     return per_block or None
 
 
+def pipeline_schedule_meta(
+    mesh: Mesh,
+    grad_accum: int,
+    pipeline_schedule: str = "gpipe",
+    virtual_stages: int = 2,
+) -> Optional[dict]:
+    """The (schedule, stages, microbatches, virtual) the compiled step's
+    pipeline actually runs, or None when the mesh has no >1 'pipe' axis.
+
+    Single source of truth for the schedule auditor's closed-form laws:
+    the microbatch count M IS ``grad_accum`` (the step feeds its whole
+    accumulation axis to the schedule — the pipeline is the gradient
+    accumulation), S is the 'pipe' mesh degree, and only the interleaved
+    schedule has V > 1 virtual chunks. Deriving these anywhere else risks
+    the laws drifting from what ``make_train_step`` compiles.
+    """
+    if mesh.shape.get("pipe", 1) <= 1:
+        return None
+    if pipeline_schedule not in ("gpipe", "1f1b", "interleaved"):
+        raise ValueError(
+            f"unknown pipeline schedule {pipeline_schedule!r} "
+            "(expected 'gpipe', '1f1b' or 'interleaved')"
+        )
+    return {
+        "schedule": pipeline_schedule,
+        "stages": int(mesh.shape["pipe"]),
+        "microbatches": int(grad_accum),
+        "virtual": (
+            int(virtual_stages) if pipeline_schedule == "interleaved" else 1
+        ),
+    }
+
+
 def global_norm_f32(tree) -> jax.Array:
     """Global L2 norm of a pytree, accumulated in f32.
 

@@ -26,14 +26,20 @@ names do not exist yet but the underlying machinery does:
 already export the real APIs — the polyfill never shadows an upstream
 implementation.
 
-Known residual limitation on old jax: the legacy PARTIALLY-auto shard_map
-(the pipeline schedules' manual-over-'pipe' region) compiles and passes
-its unit tests, but the end-to-end harness pipeline arms can hit legacy
-autodiff/partitioner gaps XLA later fixed (malformed rank-1 residual
-shardings; "PartitionId instruction is not supported" on XLA:CPU SPMD).
-Pipeline e2e runs need the current jax the codebase targets; everything
-else (all four strategy arms, tp, sp rings/Ulysses, MoE ep, the llama
-family, bench.py both arms) runs fully under the polyfill.
+Legacy partial-auto caveats (all worked around in ``parallel/`` as of
+the schedule-auditor round — see ``pipeline._legacy_partial_auto``):
+typed PRNG keys crossing the boundary get a rank-0 sharding validated
+against their rank-1 u32 physical shape (keys now cross as raw key
+data); ``lax.axis_index`` lowers to a bare partition-id the SPMD
+partitioner refuses beside a real auto axis (a P('pipe')-sharded iota
+derives the stage id from data); and a ppermute beside a >1 auto axis
+dies in the partitioner outright (the pipeline region goes manual over
+'data' too on this runtime, with explicit reductions). One REMAINING
+limitation: pipeline x tensor-parallel needs a >1 auto 'model' axis
+around the ring — structurally impossible here, refused/skipped with
+the reason. Everything else (all strategy arms, tp, sp rings/Ulysses,
+MoE ep, the llama family, all three pipeline schedules incl. e2e CLI
+runs, bench.py both arms) runs fully under the polyfill.
 """
 
 from __future__ import annotations

@@ -10,7 +10,8 @@ one-file fix.
 
 from __future__ import annotations
 
-from typing import FrozenSet, Iterable
+import functools
+from typing import FrozenSet, Iterable, Tuple
 
 import jax
 from jax import lax
@@ -42,3 +43,47 @@ def pcast_missing(x, axes: Iterable[str]):
 def pcast_like(x, *like):
     """pcast ``x`` to vary over every axis any of ``like`` varies over."""
     return pcast_missing(x, sorted(vma_of(*like)))
+
+
+@functools.lru_cache(maxsize=None)
+def _legacy_pcast_varying(axes: Tuple[str, ...]):
+    """Identity whose cotangent psums over ``axes`` — pcast's transpose.
+
+    Pre-vma runtimes have no ``lax.pcast``, but some call sites depend on
+    more than the type cast: the transpose of invariant->varying is a psum,
+    and pipeline backward passes lean on exactly that reduction (e.g. the
+    1F1B embed vjp, where the cotangent is nonzero on stage 0 only and the
+    parameter gradient must come back already summed across stages). A
+    plain-identity degrade (``pcast_missing``'s contract) would silently
+    drop that psum, so this reconstructs it with a custom_vjp.
+    """
+
+    @jax.custom_vjp
+    def cast(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (lax.psum(g, axes),)
+
+    cast.defvjp(fwd, bwd)
+    return cast
+
+
+def pcast_varying(x, axes: Iterable[str]):
+    """``lax.pcast(x, axes, to='varying')`` with a legacy-jax fallback
+    whose TRANSPOSE is preserved.
+
+    Unlike :func:`pcast_missing` (identity on pre-vma runtimes — right for
+    pure type plumbing, wrong wherever the pcast transpose psum carries
+    real gradient flow), this keeps the backward psum alive on both
+    runtimes. Use it when the call site differentiates through the cast.
+    """
+    axes = tuple(axes)
+    if not axes:
+        return x
+    if hasattr(lax, "pcast"):
+        return pcast_missing(x, axes)
+    return _legacy_pcast_varying(axes)(x)

@@ -5,7 +5,11 @@
 #
 # No args = both engines over the full arm roster; any args are passed
 # through to the CLI (e.g. `scripts/graftcheck.sh --lint`, or
-# `--audit --arms llama-tp2-gqa`). The CLI pins JAX_PLATFORMS=cpu and the
+# `--audit --arms llama-tp2-gqa`). `--changed` is the cheap pre-commit
+# path: lint only files changed vs the merge-base with the default
+# branch (no audits, ~seconds) — e.g. as a git hook:
+#   echo 'scripts/graftcheck.sh --changed' > .git/hooks/pre-commit
+# The CLI pins JAX_PLATFORMS=cpu and the
 # 8-virtual-device geometry itself, so this is safe to run inside a TPU
 # container or beside a TPU process — it never touches the chips.
 set -euo pipefail

@@ -116,7 +116,7 @@ def test_lint_is_clean_on_head():
 def test_rule_catalog_is_complete():
     assert set(lint.RULES) == {
         "GC101", "GC102", "GC103", "GC104", "GC105", "GC106", "GC107",
-        "GC108", "GC201",
+        "GC108", "GC109", "GC201",
     }
     for rule in lint.RULES.values():
         assert rule.fix_hint and rule.description
@@ -466,7 +466,9 @@ def test_budget_pins_fsdp_dp4_tp2_fallback_dead():
 
 
 def test_injection_registry_covers_bad_fsdp_axis():
-    assert set(hlo_audit._INJECTIONS) == {"bad-kv-spec", "bad-fsdp-axis"}
+    assert set(hlo_audit._INJECTIONS) == {
+        "bad-kv-spec", "bad-fsdp-axis", "bad-pipeline-spec"
+    }
 
 
 def test_bad_fsdp_axis_injection_reverts_composed_placement(eight_devices):
@@ -855,7 +857,7 @@ def test_cli_topology_v5e64_clean(topo_ok):
     proc = _cli("--topology", "v5e-64")
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "graftcheck topology: 1 tier(s), 0 finding(s)" in proc.stderr
-    assert proc.stderr.count("compiling 3 arm(s)") == 1
+    assert proc.stderr.count("compiling 4 arm(s)") == 1
 
 
 def test_cli_topology_injection_exits_one(topo_ok):
@@ -1017,3 +1019,423 @@ def test_gc108_nested_shard_map_owns_its_own_axis_scope(tmp_path):
     """)
     violations = lint.run_lint(root=bad, rules=("GC108",))
     assert len(violations) == 1 and "'bogus'" in violations[0].message
+
+
+# ---------------------------------------------------------------------------
+# Schedule auditor: pipeline arms, closed-form laws, budgets, injection
+# ---------------------------------------------------------------------------
+
+
+def test_pipeline_roster_covers_schedules_and_budgets_in_sync():
+    """All three schedules audit (tinygpt) plus a llama composition, with
+    live dropout keys (the injection's trigger), and the frozen
+    pipeline_schedules budgets track the roster exactly."""
+    scheds = {s.pipeline_schedule for s in hlo_audit.PIPELINE_ROSTER.values()}
+    assert scheds == {"gpipe", "1f1b", "interleaved"}
+    fams = {s.model_family for s in hlo_audit.PIPELINE_ROSTER.values()}
+    assert fams == {"tinygpt", "llama"}
+    for spec in hlo_audit.PIPELINE_ROSTER.values():
+        assert dict(zip(spec.axes, spec.mesh_shape)).get("pipe", 1) > 1
+        assert ("dropout", 0.1) in spec.config_overrides, (
+            f"{spec.name}: pipeline arms must audit with LIVE dropout "
+            "keys or --inject bad-pipeline-spec has nothing to break"
+        )
+    budgets = hlo_audit.load_budgets()
+    section = budgets.get("pipeline_schedules", {})
+    assert set(section.get("arms", {})) == set(hlo_audit.PIPELINE_ROSTER), (
+        "pipeline_schedules out of sync with PIPELINE_ROSTER — run "
+        "--update-budgets"
+    )
+
+
+def test_expected_pipeline_permutes_and_slopes_pure():
+    e = hlo_audit.expected_pipeline_permutes
+    # gpipe/1f1b: 2*(M+S-2); interleaved: constant 2 (one scan body).
+    assert e("gpipe", 2, 4) == 8
+    assert e("gpipe", 4, 8) == 20
+    assert e("1f1b", 2, 4) == 8
+    assert e("1f1b", 4, 16) == 36
+    assert e("interleaved", 2, 4, 2) == 2
+    assert e("interleaved", 4, 32, 4) == 2
+    assert hlo_audit.pipeline_permute_slope("gpipe") == 2
+    assert hlo_audit.pipeline_permute_slope("1f1b") == 2
+    assert hlo_audit.pipeline_permute_slope("interleaved") == 0
+    with pytest.raises(ValueError):
+        e("mpmd", 2, 4)
+
+
+def test_pipeline_bubble_bounds_pure():
+    b = hlo_audit.pipeline_bubble_bound
+    assert b("gpipe", 2, 4) == pytest.approx(1 / 5)
+    assert b("gpipe", 4, 8) == pytest.approx(3 / 11)
+    assert b("1f1b", 2, 4) == pytest.approx(2 / 6)
+    # Interleaved: the exact scheduler-table idle fraction, and MORE
+    # microbatches shrink it (the fill/drain amortizes).
+    from distributed_llm_training_benchmark_framework_tpu.parallel.interleaved import (
+        build_schedule,
+    )
+
+    assert b("interleaved", 2, 4, 2) == pytest.approx(
+        build_schedule(2, 2, 4).bubble_fraction
+    )
+    # More microbatches amortize the fill/drain (P=2's head-unit saving
+    # makes it exactly M-independent, so assert at P=4 where it shrinks).
+    assert b("interleaved", 4, 32, 2) < b("interleaved", 4, 4, 2)
+
+
+def test_pipeline_schedule_meta_matches_audit_inputs(eight_devices):
+    """The law inputs (S, M, V) come from the same contract the train
+    step compiles: train.step.pipeline_schedule_meta on the arm's real
+    mesh equals the auditor's derivation from the spec."""
+    import jax as _jax
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        make_mesh,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.step import (
+        pipeline_schedule_meta,
+    )
+
+    for spec in hlo_audit.PIPELINE_ROSTER.values():
+        n = 1
+        for d in spec.mesh_shape:
+            n *= d
+        mesh = make_mesh(spec.mesh_shape, spec.axes,
+                         devices=_jax.devices()[:n])
+        meta = pipeline_schedule_meta(
+            mesh, spec.grad_accum, spec.pipeline_schedule,
+            spec.virtual_stages,
+        )
+        result = hlo_audit.PipelineAuditResult(
+            arm=spec.name, grown_microbatches=0, **{
+                "schedule": spec.pipeline_schedule,
+                "stages": dict(zip(spec.axes, spec.mesh_shape))["pipe"],
+                "microbatches": spec.grad_accum,
+                "virtual": (
+                    spec.virtual_stages
+                    if spec.pipeline_schedule == "interleaved" else 1
+                ),
+            },
+        )
+        assert meta == {
+            "schedule": result.schedule, "stages": result.stages,
+            "microbatches": result.microbatches,
+            "virtual": result.virtual,
+        }
+    # Non-pipeline meshes yield no schedule meta.
+    flat = make_mesh((8,), ("data",), devices=_jax.devices())
+    assert pipeline_schedule_meta(flat, 4) is None
+
+
+def _pipe_result(base_perm, grown_perm, schedule="gpipe", stages=2, m=4,
+                 compile_error=None):
+    def rep(perm):
+        return hlo_audit.ArmReport(
+            arm="fake-pp", collectives={
+                "all-gather": 0, "reduce-scatter": 0, "all-reduce": 18,
+                "collective-permute": perm, "all-to-all": 0,
+            },
+            replication_reshard_suspects=0, donated_inputs=10,
+            donatable_inputs=10, bf16_to_f32_converts=0,
+        )
+
+    return hlo_audit.PipelineAuditResult(
+        arm="fake-pp", schedule=schedule, stages=stages, microbatches=m,
+        virtual=1, grown_microbatches=m * 2,
+        base=None if compile_error else rep(base_perm),
+        grown=None if compile_error else rep(grown_perm),
+        compile_error=compile_error,
+    )
+
+
+def test_pipeline_law_findings_pure():
+    # Lawful: exact closed forms at both M values.
+    ok = _pipe_result(8, 16)
+    assert hlo_audit.pipeline_law_findings(ok) == []
+    # Permute law broken at base M: named with the excess-suspect count.
+    bad = _pipe_result(11, 16)
+    findings = hlo_audit.pipeline_law_findings(bad)
+    assert any(
+        "VIOLATES permute-law at base M=4: 11" in f
+        and "3 excess permute(s)" in f for f in findings
+    ), findings
+    # Affine growth broken (slope 2 expected, got superlinear).
+    sup = _pipe_result(8, 26)
+    findings = hlo_audit.pipeline_law_findings(sup)
+    assert any("VIOLATES affine-growth" in f for f in findings), findings
+    # Compile failure IS the schedule-compiles law, named per arm.
+    dead = _pipe_result(0, 0, compile_error="XlaRuntimeError: u32[2] ...")
+    findings = hlo_audit.pipeline_law_findings(dead)
+    assert len(findings) == 1
+    assert "fake-pp VIOLATES schedule-compiles" in findings[0]
+    assert "u32[2]" in findings[0]
+
+
+def test_diff_pipeline_against_budget_pure(tmp_path):
+    ok = _pipe_result(8, 16)
+    doc = hlo_audit.write_pipeline_budgets(
+        [ok], str(tmp_path / "b.json"), existing={"arms": {}}
+    )
+    # Clean against its own freeze.
+    assert hlo_audit.diff_pipeline_against_budget(ok, doc) == []
+    # A law-respecting drift (extra all-reduce) still pins.
+    import copy
+
+    drift = copy.deepcopy(doc)
+    drift["pipeline_schedules"]["arms"]["fake-pp"]["base"][
+        "collectives"]["all-reduce"] = 17
+    deltas = hlo_audit.diff_pipeline_against_budget(ok, drift)
+    assert any("base:" in d and "all-reduce" in d for d in deltas), deltas
+    # Metadata drift names a regenerate remedy.
+    meta_drift = copy.deepcopy(doc)
+    meta_drift["pipeline_schedules"]["arms"]["fake-pp"]["schedule"][
+        "stages"] = 4
+    deltas = hlo_audit.diff_pipeline_against_budget(ok, meta_drift)
+    assert any("schedule metadata drifted" in d for d in deltas), deltas
+    # Unknown arm demands a freeze.
+    deltas = hlo_audit.diff_pipeline_against_budget(ok, {"arms": {}})
+    assert any("no frozen pipeline_schedules budget" in d for d in deltas)
+
+
+def test_write_pipeline_budgets_refuses_compile_errors(tmp_path):
+    dead = _pipe_result(0, 0, compile_error="boom")
+    with pytest.raises(ValueError, match="failed to compile"):
+        hlo_audit.write_pipeline_budgets([dead], str(tmp_path / "b.json"))
+
+
+def test_write_pipeline_budgets_refuses_partial_cross_version(tmp_path):
+    """Same contract as write_budgets: merging fresh counts over pipeline
+    arms frozen on a DIFFERENT jax (and restamping the section version)
+    would claim incomparable counts are commensurable; a full-roster
+    regen is allowed and resets the section."""
+    path = str(tmp_path / "b.json")
+    ok = _pipe_result(8, 16)
+    doc = hlo_audit.write_pipeline_budgets([ok], path, existing={"arms": {}})
+    doc["pipeline_schedules"]["jax_version"] = "9.9.9-not-this-one"
+    other = dataclasses.replace(ok, arm="other-pp")
+    with pytest.raises(ValueError, match="partial --arms regeneration"):
+        hlo_audit.write_pipeline_budgets([other], path, existing=doc)
+    # Regenerating every frozen arm across the version boundary is fine.
+    doc2 = hlo_audit.write_pipeline_budgets([ok], path, existing=doc)
+    import jax as _jax
+
+    assert doc2["pipeline_schedules"]["jax_version"] == _jax.__version__
+    assert set(doc2["pipeline_schedules"]["arms"]) == {"fake-pp"}
+
+
+def test_write_budgets_carries_pipeline_section_through(tmp_path):
+    """An arm-roster regeneration must not drop (or alter) the frozen
+    pipeline_schedules section — the --update-budgets carry-through
+    contract the topology tiers already have."""
+    path = str(tmp_path / "budgets.json")
+    ok = _pipe_result(8, 16)
+    hlo_audit.write_pipeline_budgets([ok], path, existing={"arms": {}})
+    before = hlo_audit.load_budgets(path)
+    rep = _fixture_report(arm="some-arm")
+    hlo_audit.write_budgets([rep], path, existing=before)
+    after = hlo_audit.load_budgets(path)
+    assert after["pipeline_schedules"] == before["pipeline_schedules"]
+    assert "some-arm" in after["arms"]
+
+
+@pytest.fixture(scope="module")
+def interleaved_audit(eight_devices):
+    """ONE real dual-M audit shared by the in-process proofs (the
+    interleaved executor compiles in seconds — scan body)."""
+    return hlo_audit.audit_pipeline_arm(
+        hlo_audit.PIPELINE_ROSTER["pp2-interleaved-v2"]
+    )
+
+
+def test_pipeline_head_is_lawful_and_within_budget(interleaved_audit):
+    assert interleaved_audit.compile_error is None
+    budgets = hlo_audit.load_budgets()
+    deltas = hlo_audit.diff_pipeline_against_budget(
+        interleaved_audit, budgets
+    )
+    assert deltas == [], "\n".join(deltas)
+
+
+def test_bad_pipeline_spec_injection_resurrects_seed_bug(eight_devices):
+    """--inject bad-pipeline-spec reverts the typed-key/data-manual
+    compile fix: the arm must fail to lower with the seed-old u32
+    tile-assignment rejection, the finding names arm + law, and the
+    escape hatch self-restores."""
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        pipeline as pl,
+    )
+
+    spec = dataclasses.replace(
+        hlo_audit.PIPELINE_ROSTER["pp2-interleaved-v2"],
+        inject="bad-pipeline-spec",
+    )
+    result = hlo_audit.audit_pipeline_arm(spec)
+    assert pl._TYPED_KEY_BOUNDARY_FIX is True  # restored
+    assert result.compile_error is not None
+    assert "tile assignment" in result.compile_error
+    findings = hlo_audit.pipeline_law_findings(result)
+    assert len(findings) == 1
+    assert "pp2-interleaved-v2 VIOLATES schedule-compiles" in findings[0]
+    deltas = hlo_audit.diff_pipeline_against_budget(
+        result, hlo_audit.load_budgets()
+    )
+    assert deltas == findings  # compile failure short-circuits the pins
+
+
+def test_topology_arms_include_pipeline_composition():
+    """ROADMAP PR 11 follow-up: a pp composition joins the per-tier
+    audits, with frozen budgets at every tier and the permute count
+    CONSTANT across tiers (only 'data' grows; the ring is pipe-local)."""
+    assert "pp2-gpipe" in hlo_audit.TOPOLOGY_ARMS
+    budgets = hlo_audit.load_budgets()
+    perms = set()
+    for tier, block in budgets["topology_tiers"].items():
+        assert "pp2-gpipe" in block["arms"], tier
+        perms.add(
+            block["arms"]["pp2-gpipe"]["collectives"]["collective-permute"]
+        )
+    assert len(perms) == 1  # constant in the data axis
+    # And the growth laws accept the frozen cross-tier structure.
+    growth = hlo_audit.growth_law_findings(
+        hlo_audit.assemble_per_tier(budgets)
+    )
+    assert growth == [], "\n".join(growth)
+
+
+# ---------------------------------------------------------------------------
+# GC109: per-microbatch reshard hazard in parallel/ schedule loops
+# ---------------------------------------------------------------------------
+
+
+def _scratch_parallel(tmp_path, body):
+    root = tmp_path / "scratch"
+    pkg = root / PKG / "parallel"
+    pkg.mkdir(parents=True)
+    (pkg / "sched.py").write_text(textwrap.dedent(body))
+    return str(root)
+
+
+def test_gc109_fires_on_reshard_and_sync_in_schedule_loop(tmp_path):
+    root = _scratch_parallel(tmp_path, """
+        import jax
+        from jax import lax
+
+        def run(state, specs, ticks, xs):
+            for t in range(ticks):
+                state = lax.with_sharding_constraint(state, specs)
+                state = jax.device_put(state)
+                v = float(state[0])
+                w = xs.item()
+            return state
+    """)
+    violations = lint.run_lint(root=root, rules=("GC109",))
+    lines = {v.line for v in violations}
+    assert len(violations) == 4, violations
+    assert all(v.rule_id == "GC109" for v in violations)
+    msgs = "\n".join(v.message for v in violations)
+    assert "with_sharding_constraint" in msgs
+    assert "device_put" in msgs
+    assert ".item()" in msgs
+    assert "host sync" in msgs
+
+
+def test_gc109_sees_into_loop_local_closures(tmp_path):
+    """The real tick loops put per-tick work in closures invoked via
+    lax.cond each unrolled tick — a hazard inside one is still one copy
+    per microbatch, so GC109 walks nested defs (unlike the GC102/105
+    fence walk, whose nested-def exemption is about sync_window
+    helpers)."""
+    root = _scratch_parallel(tmp_path, """
+        from jax import lax
+
+        def run(state, specs, ticks):
+            for t in range(ticks):
+                def head_work(s=state):
+                    return lax.with_sharding_constraint(s, specs)
+
+                state = lax.cond(t > 0, head_work, lambda: state)
+            return state
+    """)
+    violations = lint.run_lint(root=root, rules=("GC109",))
+    assert len(violations) == 1, violations
+    assert "with_sharding_constraint" in violations[0].message
+
+
+def test_gc109_honors_suppression_and_ignores_non_range_loops(tmp_path):
+    root = _scratch_parallel(tmp_path, """
+        import jax
+        from jax import lax
+
+        def ok(states, specs, ticks):
+            # Not a range() loop: a host iteration over a real container.
+            for s in states:
+                jax.device_put(s)
+            # Outside any loop.
+            lax.with_sharding_constraint(states[0], specs)
+            for t in range(ticks):
+                x = lax.with_sharding_constraint(  # graftcheck: disable=GC109
+                    states[0], specs
+                )
+                y = lax.ppermute(x, "pipe", [(0, 1)])  # fine
+            return y
+    """)
+    assert lint.run_lint(root=root, rules=("GC109",)) == []
+
+
+def test_gc109_clean_on_head():
+    assert lint.run_lint(rules=("GC109",)) == []
+
+
+# ---------------------------------------------------------------------------
+# --changed fast lint mode
+# ---------------------------------------------------------------------------
+
+
+def test_run_lint_files_filter_scopes_findings(tmp_path):
+    """The --changed machinery: findings are scoped to the changed set
+    while rules still see the whole tree for context."""
+    root = _scratch_parallel(tmp_path, """
+        import jax
+
+        def run(x, ticks):
+            for t in range(ticks):
+                x = jax.device_put(x)
+            return x
+    """)
+    all_v = lint.run_lint(root=root, rules=("GC109",))
+    assert len(all_v) == 1
+    rel = all_v[0].path
+    assert lint.run_lint(root=root, rules=("GC109",), files=(rel,)) == all_v
+    assert lint.run_lint(
+        root=root, rules=("GC109",), files=("somewhere/else.py",)
+    ) == []
+
+
+def test_cli_changed_is_lint_only():
+    proc = _cli("--changed", "--all")
+    assert proc.returncode == 2
+    assert "fast lint-only" in proc.stderr
+
+
+def test_cli_changed_smoke():
+    proc = _cli("--changed")
+    assert proc.returncode in (0, 1), proc.stderr[-2000:]
+    assert "graftcheck lint:" in proc.stderr
+
+
+@pytest.mark.slow
+def test_cli_pipeline_audit_clean_and_injection_exits_one():
+    """Acceptance CLI pins: the pipeline roster audits green against the
+    frozen pipeline_schedules budgets, and --inject bad-pipeline-spec
+    exits 1 naming arm + violated law."""
+    proc = _cli("--audit", "--arms",
+                "pp2-gpipe,pp2-1f1b,pp2-interleaved-v2,llama-pp2-1f1b")
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "4 pipeline arm(s), 0 finding(s)" in proc.stderr
+
+    proc = _cli("--audit", "--arms", "pp2-interleaved-v2",
+                "--inject", "bad-pipeline-spec")
+    assert proc.returncode == 1, proc.stderr[-3000:]
+    assert "VIOLATES schedule-compiles" in proc.stderr
+    assert "pp2-interleaved-v2" in proc.stderr
+    assert "tile assignment" in proc.stderr

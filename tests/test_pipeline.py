@@ -266,6 +266,19 @@ def test_pp_composes_with_tp_subprocess():
     import sys
     import textwrap
 
+    from distributed_llm_training_benchmark_framework_tpu.parallel.pipeline import (
+        _legacy_partial_auto,
+    )
+
+    if _legacy_partial_auto():
+        pytest.skip(
+            "pp x tp needs the vma shard_map runtime: the legacy "
+            "partial-auto lowering cannot partition a REAL (>1) auto "
+            "'model' axis around the pipeline ring (XLA SPMD "
+            "manual-subgroup CHECK failure). The pipeline x dp and x sp "
+            "compositions run via the data-manual legacy path instead."
+        )
+
     script = textwrap.dedent("""
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -381,3 +394,44 @@ def test_pp_sp_with_dropout_matches_gpipe(eight_devices):
             )
         )(params)
     np.testing.assert_allclose(float(f_loss), float(g_loss), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 AOT compile pins: the seed-old pipeline compile failures
+# ---------------------------------------------------------------------------
+
+#: (schedule, virtual_stages, n_layer override) — V=2 needs 4 layers.
+_AOT_SCHEDULES = [("gpipe", 1, None), ("1f1b", 1, None),
+                  ("interleaved", 2, 4)]
+
+
+@pytest.mark.parametrize("schedule,virtual,n_layer", _AOT_SCHEDULES,
+                         ids=[s for s, _, _ in _AOT_SCHEDULES])
+def test_pipeline_schedule_aot_compiles_at_dp2(eight_devices, schedule,
+                                               virtual, n_layer):
+    """NOT slow on purpose: every pipeline schedule must abstract-compile
+    at the dp=2 x pipe=2 composition WITH live dropout keys — the exact
+    shape that failed since seed (typed PRNG key crossing the partial-auto
+    shard_map boundary -> u32 tile-assignment rejection; axis_index /
+    real-auto-axis partitioner failures). A pure-compiler pin, seconds per
+    schedule, so the fix can never silently rot out of tier-1."""
+    from distributed_llm_training_benchmark_framework_tpu.analysis.static.hlo_audit import (
+        count_collectives,
+        expected_pipeline_permutes,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.step import (
+        abstract_compile_step,
+    )
+
+    over = {"n_layer": n_layer} if n_layer else {}
+    cfg = get_model_config("S", 64, **over)  # family-default dropout: keys live
+    assert cfg.dropout > 0, "the compile pin needs live dropout keys"
+    mesh = make_mesh((2, 1, 1, 2), ("data", "seq", "model", "pipe"),
+                     devices=jax.devices()[:4])
+    compiled = abstract_compile_step(
+        cfg, get_strategy("ddp"), mesh, grad_accum=4, seed=0,
+        from_table=False, global_micro=4, seq_len=64,
+        pipeline_schedule=schedule, virtual_stages=virtual,
+    )
+    got = count_collectives(compiled.as_text())["collective-permute"]
+    assert got == expected_pipeline_permutes(schedule, 2, 4, virtual)
