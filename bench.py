@@ -149,14 +149,30 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
         # Step-anatomy secondaries (additive, only when the arm profiled):
         # these ride into the registry record's result row, where the gate
         # verdicts comms_exposed_frac beside MFU/peak-HBM
-        # (stats.SECONDARY_METRICS).
-        row_extra = {
+        # (stats.SECONDARY_METRICS). update(), not assignment — a profiled
+        # run under --xla-latency-hiding must keep its scheduler-flag
+        # lineage key too.
+        row_extra.update({
             k: getattr(result, k) for k in (
                 "anatomy_compute_frac", "comms_exposed_frac",
                 "comms_overlap_frac", "anatomy_idle_frac", "bubble_frac",
                 "roofline_flops_pct_of_peak", "roofline_hbm_pct_of_peak",
             ) if getattr(result, k) is not None
-        }
+        })
+    if result.hbm_attribution is not None:
+        # Memory-anatomy columns (analysis/memory_anatomy.py): the
+        # measured+attributed HBM of this arm, riding into the registry
+        # result row so hbm_model_drift_frac gates as a secondary metric
+        # and make_report's frontier/memory tables read the attribution.
+        row_extra.update({
+            "hbm_estimate_gib": (result.hbm_estimate or {}).get("total_gib"),
+            "hbm_measured": result.hbm_measured,
+            "hbm_measured_reason": result.hbm_measured_reason,
+            "hbm_attribution": result.hbm_attribution,
+            "hbm_attribution_source": result.hbm_attribution_source,
+            "hbm_reference_gib": result.hbm_reference_gib,
+            "hbm_model_drift_frac": result.hbm_model_drift_frac,
+        })
     if remat != "inherit":
         # Frontier-sweep provenance: the REQUESTED policy keys the regress
         # lineage (store.config_key) — 'auto' stays one lineage even

@@ -15,6 +15,12 @@ from typing import List
 
 import pandas as pd
 
+# The memory-anatomy attribution classes, straight from the engine
+# (parse_metrics flattens them into hbm_attr_<class> columns) — one
+# list, so a class added there can never silently vanish from the
+# report table.
+from .memory_anatomy import ATTRIBUTION_CLASSES as _HBM_CLASSES
+
 TRADEOFFS = {
     "ddp": (
         "Data parallel (replicated)",
@@ -211,8 +217,9 @@ def remat_frontier_section(registry_root: str) -> List[str]:
             out.append(f"### {arm}")
             out.append("")
             out.append("| policy | resolved | tokens/sec/chip | vs none "
-                       "| peak HBM GB | headroom GB | MFU % |")
-            out.append("|---|---|---|---|---|---|---|")
+                       "| peak HBM GB | headroom GB | MFU % | est GiB "
+                       "| xla-temp GiB | drift % |")
+            out.append("|---|---|---|---|---|---|---|---|---|---|")
             base = ((pols.get("none") or {}).get("metric") or {}).get("value")
             for pol in sorted(pols, key=lambda p: _REMAT_ORDER.get(p, 9)):
                 rec = pols[pol]
@@ -225,11 +232,25 @@ def remat_frontier_section(registry_root: str) -> List[str]:
                     v = row.get(key)
                     return fmt.format(v) if isinstance(v, (int, float)) else "-"
 
+                # Memory-anatomy columns (memory round): the sweep's rows
+                # now carry the measured+attributed HBM — the frontier
+                # reads observed, not just estimated. Pre-anatomy records
+                # render "-".
+                attr = row.get("hbm_attribution") or {}
+                drift_v = row.get("hbm_model_drift_frac")
+                drift_s = (
+                    f"{100.0 * drift_v:.1f}"
+                    if isinstance(drift_v, (int, float)) else "-"
+                )
+                xt = attr.get("xla_temp")
                 out.append(
                     f"| {pol} | {row.get('remat_policy_resolved') or '-'} "
                     f"| {f'{val:,.2f}' if val is not None else '-'} "
                     f"| {delta} | {num('peak_hbm_gb')} "
-                    f"| {num('hbm_headroom_gb')} | {num('mfu_pct')} |"
+                    f"| {num('hbm_headroom_gb')} | {num('mfu_pct')} "
+                    f"| {num('hbm_estimate_gib')} "
+                    f"| {f'{xt:,.2f}' if isinstance(xt, (int, float)) else '-'} "
+                    f"| {drift_s} |"
                 )
             out.append("")
         return out
@@ -296,6 +317,68 @@ def anatomy_section(df: pd.DataFrame) -> List[str]:
             f"| {raw(r, 'roofline_flops_pct_of_peak')} "
             f"| {raw(r, 'roofline_hbm_pct_of_peak')} "
             f"| {raw(r, 'straggler_skew_pct')} |"
+        )
+    out.append("")
+    return out
+
+
+
+
+def memory_section(df: pd.DataFrame) -> List[str]:
+    """Per-arm HBM waterfall beside the time waterfall: the attributed
+    peak (params/grads/opt/activations/dataset/XLA-temp + signed
+    residual), the analytic estimate, the measured column (or its
+    explicit unavailability reason) and the gated model drift —
+    ``analysis/memory_anatomy.py``, docs/OBSERVABILITY.md."""
+    cols = [f"hbm_attr_{c}" for c in _HBM_CLASSES]
+    if not all(c in df.columns for c in cols):
+        return []
+    rows = df[df[cols[0]].notna()]
+    if not len(rows):
+        return []
+    out = [
+        "## Memory anatomy (HBM peak, attributed)", "",
+        "Per-chip peak attribution from the three-source reconciliation "
+        "(`analysis/memory_anatomy.py`): analytic estimate + XLA "
+        "compile-time accounting + allocator measurement. *source* names "
+        "which peak is being attributed (`allocator` measured > "
+        "`xla_buffer_assignment` > `analytic`); *residual* is the signed "
+        "book-closing remainder; *drift* = |reference − analytic| / "
+        "analytic, gated as `hbm_model_drift_frac`.", "",
+        "| strategy | ws | seq | source | peak GiB | est GiB | params "
+        "| grads | opt | act | data | xla-temp | residual | drift % |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+
+    def num(row, key, fmt="{:.2f}"):
+        v = row.get(key)
+        try:
+            v = float(v)
+        except (TypeError, ValueError):
+            return "-"
+        return fmt.format(v) if v == v else "-"
+
+    for _, r in rows.iterrows():
+        drift = r.get("hbm_model_drift_frac")
+        try:
+            drift = (f"{100.0 * float(drift):.1f}"
+                     if drift is not None and float(drift) == float(drift)
+                     else "-")
+        except (TypeError, ValueError):
+            drift = "-"
+        out.append(
+            f"| {r['strategy']} | {int(r['world_size'])} "
+            f"| {int(r['seq_len'])} "
+            f"| {r.get('hbm_attribution_source') or '-'} "
+            f"| {num(r, 'hbm_reference_gib')} "
+            f"| {num(r, 'hbm_est_total_gib')} "
+            f"| {num(r, 'hbm_attr_params')} | {num(r, 'hbm_attr_grads')} "
+            f"| {num(r, 'hbm_attr_opt_state')} "
+            f"| {num(r, 'hbm_attr_activations')} "
+            f"| {num(r, 'hbm_attr_dataset')} "
+            f"| {num(r, 'hbm_attr_xla_temp')} "
+            f"| {num(r, 'hbm_attr_unattributed', '{:+.2f}')} "
+            f"| {drift} |"
         )
     out.append("")
     return out
@@ -438,6 +521,7 @@ def build_report(
     out.append("")
 
     out += anatomy_section(df)
+    out += memory_section(df)
     if step_anatomy_txt and os.path.exists(step_anatomy_txt):
         # The suite's per-arm step-anatomy CLI tables (full component
         # breakdown incl. top collectives), shipped verbatim.
@@ -459,6 +543,7 @@ def build_report(
         ("step_time_vs_gpu.png", "Step time vs chip count"),
         ("scaling_efficiency.png", "Scaling efficiency vs chip count"),
         ("vram_vs_seqlen.png", "Peak HBM vs sequence length"),
+        ("hbm_anatomy.png", "HBM peak attribution (memory anatomy)"),
         ("gbps_vs_gpu.png", "H2D transfer proxy"),
         ("tokens_per_sec_by_strategy.png",
          "Throughput by strategy and attention impl"),

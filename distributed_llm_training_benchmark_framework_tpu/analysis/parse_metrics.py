@@ -81,12 +81,31 @@ def _partial_row(p: dict) -> dict:
     return row
 
 
+def _flatten_memory_anatomy(row: dict) -> dict:
+    """Expand the memory-anatomy dict fields into scalar CSV columns.
+
+    ``hbm_attribution`` becomes one ``hbm_attr_<class>`` column per
+    attribution class and ``hbm_estimate`` collapses to its total
+    (``hbm_est_total_gib``) — metrics.csv is the plot/report substrate
+    and dict-valued cells would stringify uselessly there; the full
+    dicts stay in the result JSON (the registry records keep them too).
+    """
+    attr = row.pop("hbm_attribution", None)
+    if isinstance(attr, dict):
+        for cls, val in attr.items():
+            row[f"hbm_attr_{cls}"] = val
+    est = row.pop("hbm_estimate", None)
+    if isinstance(est, dict):
+        row["hbm_est_total_gib"] = est.get("total_gib")
+    return row
+
+
 def load_results(results_dir: str) -> pd.DataFrame:
     rows = []
     for path in sorted(Path(results_dir).rglob("result*.json")):
         try:
             with open(path) as f:
-                rows.append(json.load(f))
+                rows.append(_flatten_memory_anatomy(json.load(f)))
         except (json.JSONDecodeError, OSError) as e:
             print(f"WARNING: skipping unreadable {path}: {e}")
     n_full = len(rows)

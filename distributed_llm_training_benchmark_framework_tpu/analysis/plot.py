@@ -211,6 +211,62 @@ def make_plots(df: pd.DataFrame, out_dir: str) -> List[str]:
         ax.grid(axis="x", visible=False)
         _save(fig, out_dir, "mfu_by_strategy.png", written)
 
+    # Memory waterfall (memory-anatomy round): per-arm stacked attribution
+    # of the reference peak — params/grads/opt/activations/dataset/
+    # XLA-temp — with the signed unattributed residual as a floating tail
+    # and the analytic estimate as a tick. Rendered whenever parse_metrics
+    # flattened hbm_attr_* columns into the frame (rows without the
+    # reconciliation are skipped). The memory-domain sibling of the time
+    # waterfall in the anatomy/scaling sections.
+    from .memory_anatomy import ATTRIBUTION_CLASSES
+
+    class_colors = {
+        "params": "#2a78d6", "grads": "#eb6834", "opt_state": "#eda100",
+        "activations": "#1baf7a", "dataset": "#e87ba4",
+        "xla_temp": "#4a3aa7",
+    }
+    attr_classes = [
+        (c, class_colors.get(c, "#008300"))
+        for c in ATTRIBUTION_CLASSES if c != "unattributed"
+    ]
+    attr_cols = [f"hbm_attr_{c}" for c, _ in attr_classes]
+    if all(c in df.columns for c in attr_cols):
+        rows = df[df[attr_cols[0]].notna()]
+        if len(rows):
+            fig, ax = plt.subplots(
+                figsize=(7, max(2.5, 0.5 * len(rows) + 1.5))
+            )
+            labels = []
+            for y, (_, r) in enumerate(rows.iterrows()):
+                left = 0.0
+                for (cls, color), col in zip(attr_classes, attr_cols):
+                    w = float(r[col]) if r[col] == r[col] else 0.0
+                    ax.barh(y, w, left=left, color=color,
+                            edgecolor=SURFACE, linewidth=0.4,
+                            label=cls if y == 0 else None)
+                    left += max(w, 0.0)
+                resid = r.get("hbm_attr_unattributed")
+                if resid is not None and resid == resid:
+                    ax.barh(y, float(resid), left=left, color="#52514e",
+                            alpha=0.5, edgecolor=SURFACE, linewidth=0.4,
+                            label="unattributed" if y == 0 else None)
+                est = r.get("hbm_est_total_gib")
+                if est is not None and est == est:
+                    ax.plot([float(est)] * 2, [y - 0.4, y + 0.4],
+                            color=TEXT, linewidth=1.2, linestyle="--",
+                            label="analytic est" if y == 0 else None)
+                labels.append(
+                    f"{r['strategy']} ws{int(r['world_size'])} "
+                    f"seq{int(r['seq_len'])}"
+                )
+            ax.set_yticks(range(len(rows)))
+            ax.set_yticklabels(labels, fontsize=8)
+            ax.legend(frameon=False, labelcolor=TEXT, fontsize=7, ncol=4)
+            _style_axes(ax, "GiB per chip", "",
+                        "HBM peak attribution (memory anatomy)")
+            ax.grid(axis="y", visible=False)
+            _save(fig, out_dir, "hbm_anatomy.png", written)
+
     # Long-context throughput: tokens/sec vs sequence length. One line per
     # (strategy, attention impl, world size) — a mixed results dir holds
     # several rows per (strategy, seq_len) and merging them into one line

@@ -145,8 +145,20 @@ def main(argv=None) -> int:
                         "staged/unstaged/untracked work) — no audits. "
                         "Rules still read unchanged files for context; "
                         "findings are scoped to the changed set")
+    p.add_argument("--memory", action="store_true",
+                   help="GC110 memory-budget audit: lower every roster arm "
+                        "on the CPU host and verdict its compile-time "
+                        "memory accounting (argument/output/temp/alias/"
+                        "peak bytes from XLA's memory_analysis) against "
+                        "the frozen memory_budgets section, plus the "
+                        "cross-tier growth laws (per-chip temps flat "
+                        "along the data axis; fsdp/zero argument bytes "
+                        "shrinking) over the frozen topology-tier memory "
+                        "budgets. With --topology TIERS, the named tiers "
+                        "are memory-audited fresh; with --update-budgets, "
+                        "freezes the memory_budgets section (only)")
     p.add_argument("--arms", default=None,
-                   help="comma-separated arm subset for --audit "
+                   help="comma-separated arm subset for --audit/--memory "
                         "(default: the whole roster)")
     p.add_argument("--topology", default=None,
                    help="comma-separated topology tier(s) "
@@ -181,7 +193,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
 
     if args.changed and (args.all or args.audit or args.topology
-                         or args.update_budgets):
+                         or args.memory or args.update_budgets):
         p.error("--changed is the fast lint-only pre-commit path; run the "
                 "audits separately (--all / --audit / --topology)")
 
@@ -237,13 +249,19 @@ def main(argv=None) -> int:
     # --all/--audit), so adding a read-only flag like --lint to a
     # topology freeze cannot silently churn the arm budgets.
     # write_budgets carries the other section through untouched.
+    # --memory claims --topology for ITSELF (the named tiers are
+    # memory-audited); the collective topology audit still runs under
+    # --all, or via --topology without --memory. A --memory freeze never
+    # regenerates the collective arm budgets (and vice versa).
+    do_memory = args.memory
     do_audit = (args.all or args.audit
-                or (args.update_budgets and not topo_tiers))
+                or (args.update_budgets and not topo_tiers
+                    and not args.memory))
     do_lint = args.all or args.lint or args.changed
-    do_topology = bool(topo_tiers) or args.all
-    if not (do_audit or do_lint or do_topology):
+    do_topology = (bool(topo_tiers) and not args.memory) or args.all
+    if not (do_audit or do_lint or do_topology or do_memory):
         p.error("nothing to do: pass --all, --audit, --lint, --changed, "
-                "--topology or --update-budgets")
+                "--memory, --topology or --update-budgets")
 
     failures = 0
 
@@ -508,6 +526,152 @@ def main(argv=None) -> int:
                     f"{len(deltas)} finding(s)", file=sys.stderr,
                 )
                 failures += len(deltas)
+
+    if do_memory:
+        budgets_path = args.budgets or hlo_audit.DEFAULT_BUDGETS_PATH
+        if args.arms:
+            mem_names = [a.strip() for a in args.arms.split(",") if a.strip()]
+            unknown = [n for n in mem_names if n not in hlo_audit.ROSTER]
+            if unknown:
+                print(f"graftcheck memory: unknown arm(s) {unknown}; "
+                      f"roster: {list(hlo_audit.ROSTER)}", file=sys.stderr)
+                return 2
+        else:
+            mem_names = list(hlo_audit.ROSTER)
+
+        import dataclasses as _dc
+
+        mem_reports = []
+        for name in mem_names:
+            spec = hlo_audit.ROSTER[name]
+            if args.inject:
+                spec = _dc.replace(spec, inject=args.inject)
+            print(f"graftcheck memory: lowering {name} ...", file=sys.stderr)
+            try:
+                mem_reports.append(hlo_audit.audit_arm_memory(spec))
+            except Exception as e:
+                print(f"graftcheck memory: arm {name} failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                return 2
+
+        fresh_mem_tiers = {}
+        if topo_tiers:
+            try:
+                for tier_name in topo_tiers:
+                    tier = hlo_audit.TOPOLOGY_TIERS[tier_name]
+                    print(f"graftcheck memory: compiling "
+                          f"{len(hlo_audit.TOPOLOGY_ARMS)} arm(s) against "
+                          f"{tier_name} ({tier.topology_name}) ...",
+                          file=sys.stderr)
+                    fresh_mem_tiers[tier_name] = (
+                        hlo_audit.audit_topology_tier_memory(
+                            tier, inject=args.inject
+                        )
+                    )
+            except hlo_audit.TopologyUnavailable as e:
+                # Tiers were explicitly requested with --memory: loud.
+                print(f"graftcheck memory: {e}", file=sys.stderr)
+                return 2
+            except Exception as e:
+                print(f"graftcheck memory: tier arm failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
+                return 2
+
+        if args.json:
+            import json as _json
+
+            doc = {r.arm: r.to_budget_entry() for r in mem_reports}
+            doc.update({
+                t: {r.arm: r.to_budget_entry() for r in reps}
+                for t, reps in fresh_mem_tiers.items()
+            })
+            print(_json.dumps(doc, indent=2, sort_keys=True))
+
+        if args.update_budgets:
+            hlo_audit.write_memory_budgets(
+                mem_reports, budgets_path, tier_reports=fresh_mem_tiers,
+            )
+            print(f"graftcheck memory: froze {len(mem_reports)} arm + "
+                  f"{len(fresh_mem_tiers)} tier memory budget(s) into "
+                  f"{budgets_path}", file=sys.stderr)
+            per_tier, _stale = hlo_audit.commensurable_memory_tiers(
+                hlo_audit.load_budgets(budgets_path),
+                fresh_tiers=tuple(fresh_mem_tiers),
+            )
+            for g in hlo_audit.memory_growth_law_findings(per_tier):
+                print(f"graftcheck memory: WARNING (frozen anyway): {g}",
+                      file=sys.stderr)
+        else:
+            if not os.path.exists(budgets_path):
+                print(f"graftcheck memory: no budgets file at "
+                      f"{budgets_path} (run --memory --update-budgets "
+                      "first)", file=sys.stderr)
+                return 2
+            budgets = hlo_audit.load_budgets(budgets_path)
+            import jax
+
+            section = budgets.get("memory_budgets", {})
+            frozen_on = section.get("jax_version")
+            if frozen_on is not None and frozen_on != jax.__version__:
+                print(
+                    f"graftcheck memory: memory_budgets frozen on jax "
+                    f"{frozen_on} but running jax {jax.__version__} — "
+                    "byte counts are not comparable; regenerate with "
+                    "--memory --update-budgets", file=sys.stderr,
+                )
+                return 2
+            deltas = []
+            for rep in mem_reports:
+                deltas.extend(
+                    hlo_audit.diff_memory_against_budget(rep, budgets)
+                )
+            per_tier, stale_tiers = hlo_audit.commensurable_memory_tiers(
+                budgets, fresh_tiers=tuple(fresh_mem_tiers),
+                jax_version=jax.__version__,
+            )
+            if stale_tiers:
+                print(
+                    "graftcheck memory: growth laws exclude tier(s) "
+                    f"{stale_tiers} frozen on a different jax — "
+                    "regenerate with --memory --topology "
+                    f"{','.join(stale_tiers)} --update-budgets",
+                    file=sys.stderr,
+                )
+            for tier_name, reps in fresh_mem_tiers.items():
+                # Same loud refusal as the collective topology path: a
+                # tier frozen on a different jax must not be byte-diffed
+                # against fresh counts (commensurable_memory_tiers keeps
+                # fresh tiers in the LAW overlay, so the version check
+                # has to happen here, before the exact pins).
+                tier_frozen = section.get("topology_tiers", {}).get(
+                    tier_name, {}
+                ).get("jax_version")
+                if tier_frozen is not None and tier_frozen != jax.__version__:
+                    print(
+                        f"graftcheck memory: {tier_name} memory budgets "
+                        f"frozen on jax {tier_frozen} but running jax "
+                        f"{jax.__version__} — regenerate with --memory "
+                        f"--topology {tier_name} --update-budgets",
+                        file=sys.stderr,
+                    )
+                    return 2
+                frozen_arms = per_tier.get(tier_name, {})
+                for rep in reps:
+                    deltas.extend(hlo_audit.diff_memory_against_budget(
+                        rep, budgets, arms_override=frozen_arms,
+                    ))
+                per_tier.setdefault(tier_name, {}).update(
+                    {r.arm: r.to_budget_entry() for r in reps}
+                )
+            deltas.extend(hlo_audit.memory_growth_law_findings(per_tier))
+            for d in deltas:
+                print(f"graftcheck memory: {d}", file=sys.stderr)
+            print(
+                f"graftcheck memory: {len(mem_reports)} arm(s) + "
+                f"{len(fresh_mem_tiers) or len(per_tier)} tier(s), "
+                f"{len(deltas)} finding(s)", file=sys.stderr,
+            )
+            failures += len(deltas)
 
     return 1 if failures else 0
 

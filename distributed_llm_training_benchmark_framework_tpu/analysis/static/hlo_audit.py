@@ -733,6 +733,294 @@ def diff_pipeline_against_budget(
 
 
 # ---------------------------------------------------------------------------
+# GC110: the memory-budget audit (compile-time memory anatomy, frozen)
+# ---------------------------------------------------------------------------
+
+#: Slack the per-chip XLA temp bytes may grow along the data axis before
+#: the GC110 temp-flat growth law fires. Weak scaling keeps per-chip work
+#: constant, so temps should be flat; a few percent covers partitioner
+#: padding differences between tier shapes.
+MEMORY_TEMP_FLAT_TOL = 0.10
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryReport:
+    """One arm's compile-time memory accounting — what GC110 pins.
+
+    Bytes come from the compiled step's ``memory_analysis()`` via
+    ``analysis.memory_anatomy.compile_memory_fields`` (ONE extractor for
+    the static audit and the runtime reconciliation, so the two layers
+    cannot disagree about what "temp bytes" means). Per-device under
+    GSPMD — the module is the per-chip program.
+    """
+
+    arm: str
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    alias_bytes: int
+    peak_bytes: int
+
+    def to_budget_entry(self) -> Dict[str, Any]:
+        return {
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
+            "peak_bytes": self.peak_bytes,
+        }
+
+
+def arm_shards_state_over_data(arm_name: str) -> bool:
+    """True when the arm's strategy shards params or optimizer state over
+    the 'data' axis (fsdp/zero) — the class whose per-chip argument bytes
+    must SHRINK as the data axis grows (a flat curve there means the
+    state is silently replicating, the exact regression GC110 exists to
+    catch AOT)."""
+    from ...parallel import get_strategy
+
+    spec = ROSTER.get(arm_name) or PIPELINE_ROSTER.get(arm_name)
+    if spec is None:
+        raise KeyError(f"unknown arm {arm_name!r}")
+    strategy = get_strategy(spec.strategy)
+    return bool(
+        getattr(strategy, "shard_params", False)
+        or getattr(strategy, "shard_opt_state", False)
+    )
+
+
+def audit_arm_memory(spec: ArmSpec, devices=None) -> MemoryReport:
+    """Lower one arm and extract its compile-time memory accounting."""
+    from ...analysis.memory_anatomy import compile_memory_fields
+
+    compiled = lower_arm(spec, devices=devices)
+    fields = compile_memory_fields(compiled)
+    if fields is None:
+        raise RuntimeError(
+            f"arm {spec.name!r}: backend exposes no memory_analysis() — "
+            "the memory audit needs a compiler that reports buffer sizes"
+        )
+    return MemoryReport(
+        arm=spec.name,
+        argument_bytes=fields["argument_bytes"],
+        output_bytes=fields["output_bytes"],
+        temp_bytes=fields["temp_bytes"],
+        alias_bytes=fields["alias_bytes"],
+        peak_bytes=fields["peak_bytes"],
+    )
+
+
+def audit_topology_tier_memory(
+    tier: "TopologyTier",
+    arm_names: Optional[Tuple[str, ...]] = None,
+    inject: Optional[str] = None,
+) -> List[MemoryReport]:
+    """Memory accounting of the scalable roster subset at one real tier."""
+    devices = topology_devices(tier)
+    reports: List[MemoryReport] = []
+    for name in arm_names or TOPOLOGY_ARMS:
+        spec = ROSTER.get(name) or PIPELINE_ROSTER[name]
+        scaled = scale_spec_to_devices(spec, tier.device_count)
+        if inject:
+            scaled = dataclasses.replace(scaled, inject=inject)
+        reports.append(audit_arm_memory(scaled, devices=devices))
+    return reports
+
+
+def write_memory_budgets(
+    reports: List[MemoryReport],
+    path: str = DEFAULT_BUDGETS_PATH,
+    tier_reports: Optional[Dict[str, List[MemoryReport]]] = None,
+) -> Dict[str, Any]:
+    """Freeze GC110 budgets into the ``memory_budgets`` section.
+
+    Merges over the existing document (the collective/pipeline/topology
+    sections pass through byte-unchanged); a partial regeneration across
+    jax versions refuses like :func:`write_budgets` — byte counts from
+    two compilers are not commensurable.
+    """
+    import jax
+
+    doc = load_budgets(path) if os.path.exists(path) else {"arms": {}}
+    section = dict(doc.get("memory_budgets", {}))
+    arms = dict(section.get("arms", {}))
+    frozen = section.get("jax_version")
+    if frozen is not None and frozen != jax.__version__ and reports:
+        regenerated = {r.arm for r in reports}
+        stale = set(arms) - regenerated
+        if stale:
+            raise ValueError(
+                f"memory_budgets were frozen on jax {frozen} but this is "
+                f"jax {jax.__version__}: a partial regeneration would mix "
+                "incomparable byte counts — regenerate the full roster "
+                f"(missing: {sorted(stale)})"
+            )
+        arms = {}
+    for r in reports:
+        arms[r.arm] = r.to_budget_entry()
+    tiers = dict(section.get("topology_tiers", {}))
+    for tier_name, reps in (tier_reports or {}).items():
+        tier = TOPOLOGY_TIERS[tier_name]
+        tiers[tier_name] = {
+            "device_count": tier.device_count,
+            "topology_name": tier.topology_name,
+            "jax_version": jax.__version__,
+            "arms": {r.arm: r.to_budget_entry() for r in reps},
+        }
+    doc["memory_budgets"] = {
+        "jax_version": jax.__version__ if reports else section.get(
+            "jax_version", jax.__version__
+        ),
+        "arms": arms,
+        "topology_tiers": tiers,
+    }
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def diff_memory_against_budget(
+    report: MemoryReport, budgets: Dict[str, Any],
+    arms_override: Optional[Dict[str, Any]] = None,
+) -> List[str]:
+    """GC110 exact-pin deltas for one arm vs the frozen memory budgets.
+
+    Same posture as the collective pins: growth of argument/output/temp/
+    peak bytes REGRESSES (an accidental replication of optimizer state
+    shows up as argument growth; a remat regression as temp growth),
+    shrinkage is an improvement to bank; LOST donation aliasing (alias
+    bytes shrinking) regresses in the other direction.
+    """
+    arms = (
+        arms_override if arms_override is not None
+        else budgets.get("memory_budgets", {}).get("arms", {})
+    )
+    entry = arms.get(report.arm)
+    if entry is None:
+        return [
+            f"GC110: {report.arm}: no frozen memory budget for this arm "
+            "(run --memory --update-budgets to freeze one)"
+        ]
+    deltas: List[str] = []
+
+    def check(label: str, got: int, want: int, more_is_worse: bool = True):
+        if got == want:
+            return
+        delta = got - want
+        pct = 100.0 * delta / want if want else float("inf")
+        if (delta > 0) == more_is_worse:
+            deltas.append(
+                f"GC110: {report.arm}: {label} REGRESSED {want} -> {got} "
+                f"({delta:+d} bytes, {pct:+.1f}%)"
+            )
+        else:
+            deltas.append(
+                f"GC110: {report.arm}: {label} improved {want} -> {got} "
+                f"({delta:+d} bytes) — bank it with --memory "
+                "--update-budgets"
+            )
+
+    check("argument bytes", report.argument_bytes, entry["argument_bytes"])
+    check("output bytes", report.output_bytes, entry["output_bytes"])
+    check("temp bytes", report.temp_bytes, entry["temp_bytes"])
+    check("donation-alias bytes", report.alias_bytes, entry["alias_bytes"],
+          more_is_worse=False)
+    check("buffer-assignment peak bytes", report.peak_bytes,
+          entry["peak_bytes"])
+    return deltas
+
+
+def memory_growth_law_findings(
+    per_tier: Dict[str, Dict[str, Dict[str, Any]]],
+) -> List[str]:
+    """GC110 cross-tier memory laws over the topology tiers.
+
+    ``per_tier`` maps tier name -> arm -> memory budget entry (frozen
+    and/or fresh — the caller overlays). Two laws, one per sharded axis
+    class, each named per arm + tier pair when broken:
+
+    - **temp-flat (dp law)**: per-chip XLA temp bytes must stay flat
+      (within :data:`MEMORY_TEMP_FLAT_TOL`) as the data axis grows —
+      weak scaling keeps per-chip batch constant, so growing temps mean
+      per-chip activation/staging state is scaling with the MESH (a
+      remat or collective-staging regression that only hurts at pod
+      scale).
+    - **sharded-state-shrinks (fsdp/zero law)**: arms whose strategy
+      shards params/optimizer state over 'data'
+      (:func:`arm_shards_state_over_data`) must show per-chip argument
+      bytes strictly DECREASING as the data axis grows — a flat curve
+      means the sharded state silently replicated (the exact failure
+      class the ZeRO papers' memory math exists to prevent).
+    """
+    findings: List[str] = []
+    tiers = sorted(
+        (t for t in per_tier if t in TOPOLOGY_TIERS),
+        key=lambda t: TOPOLOGY_TIERS[t].device_count,
+    )
+    arms = sorted({a for t in tiers for a in per_tier[t]})
+    for arm in arms:
+        present = [t for t in tiers if arm in per_tier[t]]
+        try:
+            shrinks = arm_shards_state_over_data(arm)
+        except KeyError:
+            shrinks = False
+        for lo, hi in zip(present, present[1:]):
+            e_lo, e_hi = per_tier[lo][arm], per_tier[hi][arm]
+            t_lo = int(e_lo.get("temp_bytes", 0))
+            t_hi = int(e_hi.get("temp_bytes", 0))
+            if t_lo > 0 and t_hi > t_lo * (1.0 + MEMORY_TEMP_FLAT_TOL):
+                findings.append(
+                    f"GC110 growth-law: {arm} per-chip temp bytes grew "
+                    f"{100.0 * (t_hi - t_lo) / t_lo:+.1f}% along the data "
+                    f"axis ({lo}: {t_lo} -> {hi}: {t_hi}; weak scaling "
+                    "must keep per-chip temps flat within "
+                    f"{100 * MEMORY_TEMP_FLAT_TOL:.0f}%)"
+                )
+            if shrinks:
+                a_lo = int(e_lo.get("argument_bytes", 0))
+                a_hi = int(e_hi.get("argument_bytes", 0))
+                if a_lo > 0 and a_hi >= a_lo:
+                    findings.append(
+                        f"GC110 growth-law: {arm} per-chip argument bytes "
+                        f"did not shrink along the fsdp/zero shard axis "
+                        f"({lo}: {a_lo} -> {hi}: {a_hi}) — sharded "
+                        "param/optimizer state is replicating instead of "
+                        "sharding"
+                    )
+    return findings
+
+
+def commensurable_memory_tiers(
+    budgets: Dict[str, Any],
+    fresh_tiers: Tuple[str, ...] = (),
+    jax_version: Optional[str] = None,
+) -> Tuple[Dict[str, Dict[str, Dict[str, Any]]], List[str]]:
+    """(per-tier memory entries with cross-version tiers dropped, dropped).
+
+    The memory analogue of :func:`commensurable_topology_tiers`: byte
+    counts from a different compiler must not enter the cross-tier laws.
+    Returns the assembled ``{tier: {arm: entry}}`` view directly.
+    """
+    if jax_version is None:
+        import jax
+
+        jax_version = jax.__version__
+    blocks = budgets.get("memory_budgets", {}).get("topology_tiers", {})
+    stale = sorted(
+        t for t, b in blocks.items()
+        if t not in fresh_tiers
+        and b.get("jax_version") not in (None, jax_version)
+    )
+    per_tier = {
+        t: dict(b.get("arms", {}))
+        for t, b in blocks.items() if t not in stale
+    }
+    return per_tier, stale
+
+
+# ---------------------------------------------------------------------------
 # Topology tiers: AOT audits of pod-scale meshes on the CPU host
 # ---------------------------------------------------------------------------
 
@@ -1108,6 +1396,9 @@ def write_budgets(
         # Same carry-through contract for the pipeline-schedule budgets
         # (frozen by write_pipeline_budgets).
         doc["pipeline_schedules"] = existing["pipeline_schedules"]
+    if existing is not None and existing.get("memory_budgets"):
+        # ...and for the GC110 memory budgets (write_memory_budgets).
+        doc["memory_budgets"] = existing["memory_budgets"]
     if existing is not None:
         # A partial regeneration on a different jax than the file was
         # frozen on would mix incomparable counts — and silently dropping

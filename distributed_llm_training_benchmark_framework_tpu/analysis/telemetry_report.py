@@ -106,6 +106,47 @@ def build_timeline(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
+def hbm_timeline_lines(
+    windows: List[Dict[str, Any]], width: int = 44,
+) -> List[str]:
+    """The HBM high-water timeline across a run's sync windows.
+
+    Memory-anatomy round: the recorder samples the allocator's peak (and
+    live bytes-in-use) per window, so a run's memory trajectory — when
+    the high-water mark was set, how close to it the steady state runs —
+    is reconstructible from the heartbeat/JSONL channel alone, mid-run
+    or post-mortem. Renders an ASCII sparkline scaled to the run's own
+    maximum plus first/high-water/last figures; empty list when no
+    window carried a sample (CPU backends).
+    """
+    pts = [(w.get("step"), w.get("peak_hbm_bytes"), w.get("hbm_bytes_in_use"))
+           for w in windows if w.get("peak_hbm_bytes") is not None]
+    if not pts:
+        return []
+    peaks = [p for _s, p, _c in pts]
+    hi = max(peaks) or 1
+    levels = " .:-=+*#%@"
+    spark = "".join(
+        levels[min(int(p / hi * (len(levels) - 1)), len(levels) - 1)]
+        for _s, p, _c in pts[-width:]
+    )
+    hw_step = next(s for s, p, _c in pts if p == max(peaks))
+    out = [
+        f"  HBM high-water timeline ({len(pts)} sampled windows): "
+        f"first {peaks[0] / 2**30:.2f} GiB -> high-water "
+        f"{max(peaks) / 2**30:.2f} GiB @ step {hw_step} -> last "
+        f"{peaks[-1] / 2**30:.2f} GiB",
+        f"    |{spark}|",
+    ]
+    in_use = [c for _s, _p, c in pts if c is not None]
+    if in_use:
+        out.append(
+            f"    live bytes-in-use: last {in_use[-1] / 2**30:.2f} GiB "
+            f"({100.0 * in_use[-1] / hi:.0f}% of the high-water mark)"
+        )
+    return out
+
+
 def _gantt_bar(iv: Dict[str, Any], wall: float, width: int = 44) -> str:
     if wall <= 0:
         return ""
@@ -180,6 +221,7 @@ def format_report(tl: Dict[str, Any]) -> str:
                    f" ({ws[-1]['cum_tokens']:,} tokens)")
         if hbm:
             out.append(f"  peak HBM (allocator): {max(hbm) / 1e9:.2f} GB")
+        out.extend(hbm_timeline_lines(ws))
 
     if tl["anomalies"]:
         out.append("")
@@ -505,6 +547,10 @@ def write_plots(tl: Dict[str, Any], out_dir: str) -> List[str]:
          [None if w.get("peak_hbm_bytes") is None
           else w["peak_hbm_bytes"] / 1e9 for w in ws], "HBM",
          "telemetry_hbm.png"),
+        ("HBM in use (GB)",
+         [None if w.get("hbm_bytes_in_use") is None
+          else w["hbm_bytes_in_use"] / 1e9 for w in ws], "HBM in use",
+         "telemetry_hbm_in_use.png"),
     ]
     for ylabel, ys, title, fname in series:
         pts = [(s, y) for s, y in zip(steps, ys) if y is not None]

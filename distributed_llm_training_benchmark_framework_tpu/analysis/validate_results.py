@@ -141,6 +141,15 @@ ANATOMY_FRAC_FIELDS = (
 )
 ANATOMY_COMPONENT_SUM_TOL = 1.02
 ROOFLINE_PCT_MAX = 110.0
+# Memory-anatomy envelope (analysis/memory_anatomy.py): rows carrying the
+# reconciliation must be internally coherent — the persisted estimate and
+# the measured column must COEXIST (hbm_measured may be null only with an
+# explicit reason), every attribution class except the signed residual is
+# non-negative, and the classes must close the books on the reference
+# peak (that is the reconciliation's defining invariant; a gap means the
+# engine and the stored row drifted). Rows without the fields
+# (pre-memory-anatomy artifacts) skip every check.
+HBM_BOOKS_CLOSE_TOL_GIB = 0.002
 
 
 def _check(ok: bool, label: str, detail: str, failures: List[str]) -> None:
@@ -426,6 +435,59 @@ def validate_result(r: dict, name: str) -> List[str]:
     if skew is not None:
         _check(skew >= 0.0, name,
                f"straggler_skew_pct={skew} is negative", f)
+
+    # Memory-anatomy envelope (HBM_BOOKS_CLOSE_TOL_GIB above).
+    attr = r.get("hbm_attribution")
+    if isinstance(attr, dict):
+        _check(
+            isinstance(r.get("hbm_estimate"), dict)
+            and r["hbm_estimate"].get("total_gib") is not None, name,
+            "hbm_attribution present without the hbm_estimate breakdown "
+            "— the estimate and measurement must coexist so drift is "
+            "computable offline", f,
+        )
+        _check(
+            "hbm_measured" in r, name,
+            "hbm_attribution present without an hbm_measured key (null "
+            "is legal, absence is not)", f,
+        )
+        if r.get("hbm_measured") is None:
+            _check(
+                bool(r.get("hbm_measured_reason")), name,
+                "hbm_measured is null without an hbm_measured_reason — "
+                "an unmeasured peak must say why", f,
+            )
+        else:
+            _check(
+                r.get("hbm_model_drift_frac") is not None, name,
+                "hbm_measured present but hbm_model_drift_frac is null "
+                "— a measured peak beside an estimate must yield a "
+                "drift", f,
+            )
+        for cls, val in attr.items():
+            if cls == "unattributed":
+                continue  # the signed book-closing residual
+            _check(
+                isinstance(val, (int, float)) and val >= 0, name,
+                f"hbm_attribution[{cls}]={val} is negative — only the "
+                "unattributed residual may be signed", f,
+            )
+        ref = r.get("hbm_reference_gib")
+        if isinstance(ref, (int, float)):
+            total = sum(
+                v for v in attr.values() if isinstance(v, (int, float))
+            )
+            _check(
+                abs(total - ref) <= HBM_BOOKS_CLOSE_TOL_GIB
+                + 0.0005 * len(attr), name,
+                f"hbm_attribution classes sum to {total:.4f} GiB but "
+                f"hbm_reference_gib={ref:.4f} — the reconciliation must "
+                "close the books exactly", f,
+            )
+        drift = _finite("hbm_model_drift_frac")
+        if drift is not None:
+            _check(drift >= 0.0, name,
+                   f"hbm_model_drift_frac={drift} is negative", f)
     return f
 
 

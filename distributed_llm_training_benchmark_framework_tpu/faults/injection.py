@@ -67,6 +67,15 @@ FAULT_KINDS = {
                     "factor before step N dispatches, so the step's "
                     "global grad-norm explodes (the sentinel's grad-norm "
                     "guard must trip and roll back)",
+    "opt-moments": "opt-moments@N — collapse the optimizer's second-"
+                   "moment (Adam nu) accumulators toward zero before "
+                   "step N dispatches: step N's update explodes (m/"
+                   "(sqrt(nu)+eps) with a vanishing denominator) while "
+                   "step N's own loss/grads stay healthy, so step N+1's "
+                   "global grad-norm spikes FIRST — the sentinel's "
+                   "grad-norm guard must trip before the loss/checksum "
+                   "guards and roll back (the ROADMAP carry-forward "
+                   "fault class no other spec exercises)",
     "torn-checkpoint": "tear the newest checkpoint after a save that has "
                        "a previous committed step, then SIGKILL (restore "
                        "must quarantine and fall back)",
@@ -77,7 +86,7 @@ FAULT_KINDS = {
 #: Kinds that take a mandatory ``@N`` step.
 STEPPED_KINDS = frozenset(
     {"sigkill", "sigterm", "sigterm-rank", "nan-loss", "hang",
-     "stall-rank", "bitflip", "grad-explode"}
+     "stall-rank", "bitflip", "grad-explode", "opt-moments"}
 )
 
 #: Kinds whose ``@N:R`` suffix names a target rank.
@@ -92,6 +101,23 @@ BITFLIP_VALUE = 1e30
 #: goes non-finite (the *envelope* guards must catch it, not a NaN
 #: screen).
 GRAD_EXPLODE_SCALE = 1e3
+#: opt-moments: the exponent-burst scales for the Adam moment buffers.
+#: The second moments (nu) collapse toward zero and the paired first
+#: moments (mu) flip UP — one SDC burst across the adjacent moment
+#: state. Both halves are needed for a physical reason worth recording:
+#: a pure nu collapse CANNOT spike the next step's gradients, because
+#: optax updates the moments BEFORE computing the step — the
+#: ``(1 - b2) * g^2`` refill rebuilds the denominator within the very
+#: corrupted step, bounding the update inflation at ``1/sqrt(1-b2)``
+#: (~31x, and only ~3x at early step counts under bias correction):
+#: a 31x-effective-lr drift, not an explosion. The corrupted mu has the
+#: opposite refill asymmetry — ``b1 * mu`` RETAINS the corruption — so
+#: the update explodes ~1e4x through the numerator while the step's own
+#: loss/grads stay healthy: the first observable symptom is the NEXT
+#: step's global grad-norm, which is exactly the guard this spec exists
+#: to prove fires before the loss/checksum guards.
+MOMENT_COLLAPSE_SCALE = 1e-8
+MOMENT_BURST_SCALE = 1e4
 
 #: Default stall for ``hang`` when the spec carries no ``:SECS``. Long
 #: enough that any sane per-run timeout (or the k8s liveness probe) fires
@@ -399,6 +425,74 @@ class FaultInjector:
             return poisoned if path == victim_path else leaf
 
         return jax.tree_util.tree_map_with_path(swap, params)
+
+    def corrupt_opt_state(self, step: int, opt_state):
+        """Corrupt the Adam moment buffers before step N dispatches
+        (``opt-moments@N``; else passthrough).
+
+        One exponent burst across the optimizer's moment state: every
+        leaf under a ``nu`` field (optax's ``ScaleByAdamState.nu`` —
+        matched by the exact attribute name in the tree path, so a
+        parameter coincidentally containing 'nu' can never be hit)
+        collapses by :data:`MOMENT_COLLAPSE_SCALE`, and the paired
+        ``mu`` leaves flip up by :data:`MOMENT_BURST_SCALE` (see the
+        constants' note for why the mu half is load-bearing: the nu
+        refill self-heals within the corrupted step). The corrupted
+        step itself computes HEALTHY loss and gradients — the poison
+        only enters through the optimizer update — which is what makes
+        this the one fault class whose first observable symptom is the
+        NEXT step's exploding grad-norm: the sentinel's grad-norm guard
+        must trip before the loss/checksum guards ever see anything.
+        Pure device ops on the fenced pre-dispatch handle, like
+        ``corrupt_params``. The moment buffers are also the state no
+        other guard covers at rest — the checkpoint digests protect
+        them on disk, but in HBM a flipped moment is invisible until
+        the update fires.
+        """
+        if (
+            self.spec is None or self.fired
+            or self.spec.kind != "opt-moments" or step != self.spec.step
+        ):
+            return opt_state
+        self.fired = True
+        import jax
+        import jax.numpy as jnp
+
+        flat = jax.tree_util.tree_flatten_with_path(opt_state)[0]
+
+        def moment_field(path):
+            for e in path:
+                if getattr(e, "name", None) in ("mu", "nu"):
+                    return e.name
+            return None
+
+        n_nu = sum(1 for path, leaf in flat
+                   if moment_field(path) == "nu" and hasattr(leaf, "dtype"))
+        if n_nu == 0:
+            # An optimizer layout without Adam moments (e.g. a future
+            # SGD arm): the fault has nothing to corrupt — say so
+            # loudly rather than silently passing a healthy run off as
+            # a survived injection.
+            self._announce(
+                "opt-moments: no Adam moment (mu/nu) leaves in this "
+                "optimizer state — fault inert"
+            )
+            return opt_state
+        self._announce(
+            f"opt-moments: collapsing {n_nu} second-moment (nu) leaves "
+            f"x{MOMENT_COLLAPSE_SCALE:g} and bursting the paired mu "
+            f"leaves x{MOMENT_BURST_SCALE:g} before step {step}"
+        )
+
+        def scale(path, leaf):
+            field = moment_field(path)
+            if field is None or not hasattr(leaf, "dtype"):
+                return leaf
+            factor = (MOMENT_COLLAPSE_SCALE if field == "nu"
+                      else MOMENT_BURST_SCALE)
+            return leaf * jnp.asarray(factor, leaf.dtype)
+
+        return jax.tree_util.tree_map_with_path(scale, opt_state)
 
     # -- save-path faults --------------------------------------------------
 

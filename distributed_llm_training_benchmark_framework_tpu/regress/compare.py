@@ -600,8 +600,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(line)
                 if verdict == stats.VERDICT_REGRESSION:
                     n_regressions += 1
+            # The summary names the secondary-metric roster so a gate
+            # transcript is self-describing about WHAT was policed —
+            # scripts/regress_gate.sh surfaces this line as its verdict.
+            secondaries = ", ".join(
+                key for key, _hib, _eff, _scale in stats.SECONDARY_METRICS
+            )
             print(f"regress gate: {len(arms)} arm(s) checked, "
-                  f"{n_regressions} regression(s)")
+                  f"{n_regressions} regression(s) "
+                  f"(secondaries gated: {secondaries})")
             return 1 if n_regressions else 0
 
         if args.cmd == "list":

@@ -99,6 +99,17 @@ SECONDARY_METRICS = (
     # the dynamic half of the schedule auditor's structural bubble
     # bound (docs/STATIC_ANALYSIS.md).
     ("bubble_frac", False, 2.0, "abs_pp"),
+    # Memory-anatomy model drift (analysis/memory_anatomy.py):
+    # |reference peak − analytic estimate| / analytic, where the
+    # reference is the allocator's measured peak (or XLA's
+    # buffer-assignment peak on backends without memory_stats). Lower is
+    # better — a growing drift means utils/memory.py's analytic model is
+    # decaying, which silently degrades the pre-flight OOM refusals and
+    # the auto-remat resolver that trust it. Absolute pp scale (a
+    # healthy drift can legitimately sit near 0); 5 pp floor because the
+    # model's documented accuracy band is ±20% — the gate polices
+    # DECAY, not the residual itself.
+    ("hbm_model_drift_frac", False, 5.0, "abs_pp"),
 )
 #: Absolute-scale fallback noise floor (percentage points) below 3
 #: same-config history runs.

@@ -259,6 +259,7 @@ class TelemetryRecorder:
         self._window_dts: List[float] = []
         self._n_anomalies = 0
         self._nan_anomalies = 0
+        self._last_hbm_peak_gib: Optional[float] = None
         self._open_spike: Optional[int] = None  # step that opened the spike
         self._spike_dts: List[float] = []  # window dts while a spike is open
         self.path: Optional[str] = None
@@ -432,12 +433,19 @@ class TelemetryRecorder:
         tps = (self._cum_tokens / self._cum_window_sec
                if self._cum_window_sec > 0 else 0.0)
         hbm = None
+        hbm_now = None
         try:
-            from ..utils.metrics import peak_hbm_bytes
+            from ..utils.metrics import hbm_bytes_in_use, peak_hbm_bytes
 
             hbm = peak_hbm_bytes()
+            hbm_now = hbm_bytes_in_use()
         except Exception:
             pass
+        if hbm is not None:
+            # Live high-water mark for the heartbeat channel (memory
+            # anatomy round): the liveness probe surfaces memory
+            # pressure mid-run instead of only post-mortem.
+            self._last_hbm_peak_gib = round(hbm / 2**30, 3)
         self._emit(
             "step_window",
             step=last_step,
@@ -449,6 +457,7 @@ class TelemetryRecorder:
             cum_tokens=self._cum_tokens,
             tokens_per_sec=round(tps, 3),
             peak_hbm_bytes=hbm,
+            hbm_bytes_in_use=hbm_now,
             phase=self._phase,
         )
         self._screen_anomalies(last_step, losses, window_mean_step_time_sec)
@@ -524,6 +533,10 @@ class TelemetryRecorder:
             "phase": self._phase,
             "ts": round(time.time(), 3),
         }
+        if self._last_hbm_peak_gib is not None:
+            # Live memory pressure in the scrape channel (memory-anatomy
+            # round): scripts/liveness_probe.sh surfaces it mid-run.
+            payload["hbm_peak_gib"] = self._last_hbm_peak_gib
         payload.update(self.meta)
         # flush=True: heartbeats must reach a pipe/pod log immediately —
         # a block-buffered stdout would hold them hostage past a SIGKILL.
@@ -557,6 +570,8 @@ class TelemetryRecorder:
             "reason": reason,
             "ts": round(time.time(), 3),
         }
+        if self._last_hbm_peak_gib is not None:
+            payload["hbm_peak_gib"] = self._last_hbm_peak_gib
         payload.update(self.meta)
         payload.update(extra or {})
         print(f"{HEARTBEAT_MARKER} {json.dumps(payload)}", flush=True)

@@ -1086,8 +1086,11 @@ def _run_benchmark_impl(
         # Chaos param corruption (bitflip/grad-explode): poisons the
         # pre-dispatch handle exactly once at its armed step — the
         # sentinel-proof injection point. Inert (one attribute check)
-        # when not armed.
+        # when not armed. opt-moments poisons the OPTIMIZER state
+        # instead (collapsed Adam second moments -> step N's update
+        # explodes -> step N+1's grad-norm guard must trip FIRST).
         params = chaos.corrupt_params(step, params)
+        opt_state = chaos.corrupt_opt_state(step, opt_state)
         if numerics is None:
             params, opt_state, loss = active_state.step_fn(
                 params, opt_state, table, step
@@ -1307,23 +1310,18 @@ def _run_benchmark_impl(
     # (scripts/liveness_probe.sh).
     watchdog.disarm()
 
-    # Fetch the step executable for XLA's measured memory accounting — only
-    # needed when the allocator can't report a peak itself (measure_peak_hbm
-    # rung 2). Cache hit after the run — costs <1ms on this jit cache; the
-    # guard avoids even that (and any cache-miss recompile) on runtimes
-    # whose memory_stats() works.
+    # Fetch the step executable for XLA's compile-time accounting — one
+    # fetch serves all three consumers below: measure_peak_hbm rung 2
+    # (when the allocator can't report a peak), the step-anatomy
+    # roofline, and the memory-anatomy reconciliation (which ALWAYS
+    # wants the compile-time half). Cache hit after the run — the AOT
+    # path shares the jit executable cache, <1ms.
     compiled_step = None
-    _aot_compile_failed = False
-    _alloc_peak = metrics_mod.peak_hbm_bytes()
-    if _alloc_peak is None or (
-        prior_peak_bytes is not None and _alloc_peak <= prior_peak_bytes
-    ):
-        try:
-            compiled_step = active_state.aot_compile(params, opt_state, table, 0)
-        except Exception as e:  # degrade down the fallback chain, never fail a run
-            _aot_compile_failed = True
-            if is_main:
-                print(f"WARNING: step AOT compile for memory accounting failed: {e}")
+    try:
+        compiled_step = active_state.aot_compile(params, opt_state, table, 0)
+    except Exception as e:  # degrade down the fallback chain, never fail a run
+        if is_main:
+            print(f"WARNING: step AOT compile for memory accounting failed: {e}")
 
     # Step-anatomy attribution (analysis/step_anatomy.py, docs/
     # OBSERVABILITY.md): when this run captured a profiler trace, decompose
@@ -1341,14 +1339,6 @@ def _run_benchmark_impl(
             from ..analysis import step_anatomy as anatomy_mod
 
             cstep = compiled_step
-            if cstep is None and not _aot_compile_failed:
-                # Compile skipped above (allocator peak sufficed) — worth
-                # attempting for the roofline; a compile that already
-                # FAILED above is not worth paying for twice.
-                try:
-                    cstep = active_state.aot_compile(params, opt_state, table, 0)
-                except Exception:
-                    cstep = None
             cost = None
             if cstep is not None:
                 cost = anatomy_mod.cost_from_compiled(
@@ -1375,6 +1365,38 @@ def _run_benchmark_impl(
             print(anatomy_mod.format_report(report))
         except Exception as e:
             print(f"WARNING: step-anatomy attribution skipped: {e}")
+
+    # Memory-anatomy reconciliation (analysis/memory_anatomy.py, docs/
+    # OBSERVABILITY.md): fold the three memory sources this run already
+    # produced — the pre-flight analytic estimate, XLA's compile-time
+    # buffer accounting off the (cache-hit) step executable, and the
+    # allocator's measured peak (explicitly null-with-reason on backends
+    # without memory_stats) — into the per-class attribution + the
+    # hbm_model_drift_frac secondary metric. Best-effort like the step
+    # anatomy: a reconciliation failure degrades with a warning, never
+    # fails the measured run.
+    memory_anatomy_fields = None
+    try:
+        from ..analysis import memory_anatomy as memano
+
+        measured_b, measured_reason = memano.measured_peak_bytes(
+            prior_peak_bytes
+        )
+        mem_report = memano.reconcile(
+            est,
+            compile_mem=memano.compile_memory_fields(compiled_step),
+            measured_bytes=measured_b,
+            measured_reason=measured_reason,
+        )
+        memory_anatomy_fields = memano.result_fields(
+            mem_report, est_breakdown=est.breakdown()
+        )
+        recorder.note("memory_anatomy", **memory_anatomy_fields)
+        if is_main:
+            print(memano.format_report(mem_report))
+    except Exception as e:
+        if is_main:
+            print(f"WARNING: memory-anatomy reconciliation skipped: {e}")
 
     # MoE runs: measure the expert-capacity overflow (dropped-assignment
     # fraction) on the trained params with one diagnostic forward — the
@@ -1472,6 +1494,7 @@ def _run_benchmark_impl(
         phase_times=recorder.phase_times(),
         n_anomalies=recorder.n_anomalies,
         step_anatomy=step_anatomy_fields,
+        memory_anatomy=memory_anatomy_fields,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

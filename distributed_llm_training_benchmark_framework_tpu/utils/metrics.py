@@ -81,6 +81,25 @@ def peak_hbm_bytes() -> Optional[int]:
     return max(peaks) if peaks else None
 
 
+def hbm_bytes_in_use() -> Optional[int]:
+    """Current device-memory bytes in use, or None when the backend
+    can't say — the live sibling of :func:`peak_hbm_bytes`, sampled per
+    sync window by the flight recorder so the HBM high-water timeline is
+    reconstructible from telemetry alone (docs/OBSERVABILITY.md memory
+    anatomy)."""
+    import jax
+
+    vals = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "bytes_in_use" in stats:
+            vals.append(int(stats["bytes_in_use"]))
+    return max(vals) if vals else None
+
+
 def buffer_assignment_peak_bytes(ma) -> int:
     """XLA's buffer-assignment peak from a ``memory_analysis()`` result.
 
@@ -379,6 +398,26 @@ class BenchmarkResult:
     # schedule, so flagged and unflagged runs must never cross-gate in the
     # regress registry (store.config_key includes this field).
     xla_scheduler_flags: str = ""
+    # --- memory-anatomy reconciliation (analysis/memory_anatomy.py) —
+    # the per-chip HBM peak, attributed. ``hbm_estimate`` persists the
+    # pre-flight analytic breakdown (utils.memory.HBMEstimate.breakdown,
+    # GiB keys — previously print-only); ``hbm_measured`` is the
+    # allocator's peak in GiB or None-with-reason when the backend lacks
+    # memory_stats(); ``hbm_attribution`` splits the reference peak
+    # (source in ``hbm_attribution_source``, total in
+    # ``hbm_reference_gib``) across params/grads/opt_state/activations/
+    # dataset/xla_temp plus a SIGNED unattributed residual that closes
+    # the books exactly. ``hbm_model_drift_frac`` — |reference −
+    # analytic| / analytic — is a gated secondary metric
+    # (regress.stats.SECONDARY_METRICS): the estimator's ±20% disclaimer
+    # as a tested invariant. All None for pre-memory-anatomy artifacts.
+    hbm_estimate: Optional[Dict[str, float]] = None
+    hbm_measured: Optional[float] = None
+    hbm_measured_reason: str = ""
+    hbm_attribution: Optional[Dict[str, float]] = None
+    hbm_attribution_source: str = ""
+    hbm_reference_gib: Optional[float] = None
+    hbm_model_drift_frac: Optional[float] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -439,6 +478,7 @@ def compute_result(
     phase_times: Optional[Dict[str, float]] = None,
     n_anomalies: int = 0,
     step_anatomy: Optional[Dict[str, Any]] = None,
+    memory_anatomy: Optional[Dict[str, Any]] = None,
 ) -> BenchmarkResult:
     def _scheduler_flags() -> str:
         from . import platform as platform_mod
@@ -511,6 +551,28 @@ def compute_result(
             f"unknown step_anatomy keys {sorted(anatomy)} (the engine's "
             "result_fields and BenchmarkResult must agree)"
         )
+    # Memory-anatomy fields (analysis.memory_anatomy.result_fields keys):
+    # same refusal contract as step_anatomy — the engine and the result
+    # schema must not drift apart.
+    mem = dict(memory_anatomy or {})
+    mem_fields = {
+        k: mem.pop(k, None if k not in (
+            "hbm_measured_reason", "hbm_attribution_source",
+        ) else "") for k in (
+            "hbm_estimate", "hbm_measured", "hbm_measured_reason",
+            "hbm_attribution", "hbm_attribution_source",
+            "hbm_reference_gib", "hbm_model_drift_frac",
+        )
+    }
+    if mem_fields["hbm_measured_reason"] is None:
+        mem_fields["hbm_measured_reason"] = ""
+    if mem_fields["hbm_attribution_source"] is None:
+        mem_fields["hbm_attribution_source"] = ""
+    if mem:
+        raise ValueError(
+            f"unknown memory_anatomy keys {sorted(mem)} (the engine's "
+            "result_fields and BenchmarkResult must agree)"
+        )
     return BenchmarkResult(
         strategy=strategy,
         world_size=world_size,
@@ -579,6 +641,7 @@ def compute_result(
         n_anomalies=n_anomalies,
         xla_scheduler_flags=_scheduler_flags(),
         **anatomy_fields,
+        **mem_fields,
     )
 
 
@@ -615,6 +678,28 @@ def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True)
         f"  Peak HBM/chip:    {result.peak_hbm_gb:.2f} GB"
         f" ({result.peak_hbm_method})"
     )
+    if result.hbm_attribution is not None:
+        attr = result.hbm_attribution
+        measured = (
+            f"{result.hbm_measured:.2f} GiB measured"
+            if result.hbm_measured is not None
+            else f"measured n/a ({result.hbm_measured_reason})"
+        )
+        drift = (
+            f", model drift {100.0 * result.hbm_model_drift_frac:.1f}%"
+            if result.hbm_model_drift_frac is not None else ""
+        )
+        print(
+            f"  HBM anatomy:      {measured}; "
+            f"{result.hbm_attribution_source} peak "
+            f"{result.hbm_reference_gib or 0:.2f} GiB = params "
+            f"{attr.get('params', 0):.2f} + grads {attr.get('grads', 0):.2f}"
+            f" + opt {attr.get('opt_state', 0):.2f} + act "
+            f"{attr.get('activations', 0):.2f} + data "
+            f"{attr.get('dataset', 0):.2f} + xla-temp "
+            f"{attr.get('xla_temp', 0):.2f} "
+            f"{attr.get('unattributed', 0):+.2f} residual{drift}"
+        )
     print(f"  H2D GB/s/chip:    {result.h2d_gbps_per_gpu:.3f}")
     print(f"  Mean loss:        {result.mean_loss:.4f}")
     if result.wall_time_total_sec > 0:

@@ -6,10 +6,14 @@
 #   - a completed, validated result (after resume where the class allows
 #     recovery): sigkill, sigterm, torn-checkpoint, enospc-on-save;
 #   - a completed, validated result WITHOUT any restart (self-healing
-#     round): bitflip and grad-explode trip the numerics sentinel, which
-#     rolls back in-process to the last validated checkpoint and replays
-#     — the row publishes n_rollbacks=1 and its registry record is never
-#     a gate baseline;
+#     round): bitflip, grad-explode and opt-moments trip the numerics
+#     sentinel (checksum, loss-envelope and grad-norm guards
+#     respectively — opt-moments corrupts the Adam moment buffers so the
+#     NEXT step's grad-norm explodes while its loss stays finite, the
+#     one class the grad-norm guard catches FIRST), which rolls back
+#     in-process to the last validated checkpoint and replays — the row
+#     publishes n_rollbacks=1 and its registry record is never a gate
+#     baseline;
 #   - a correctly classified failure: nan-loss completes but
 #     validate_results REJECTS the row (unresolved anomaly); hang is
 #     caught by the IN-PROCESS watchdog (--hang-timeout-sec), which dumps
@@ -56,7 +60,7 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 REPO_ROOT="$(pwd)"
 
-FAULTS="sigkill sigterm sigterm-rank nan-loss hang stall-rank bitflip grad-explode torn-checkpoint enospc-on-save"
+FAULTS="sigkill sigterm sigterm-rank nan-loss hang stall-rank bitflip grad-explode opt-moments torn-checkpoint enospc-on-save"
 ROOT=""
 KEEP=0
 ELASTIC=0
@@ -226,7 +230,7 @@ for fault in $FAULTS; do
       fi
       check_recovered "$fault" "$dir"
       ;;
-    bitflip|grad-explode)
+    bitflip|grad-explode|opt-moments)
       # Numerics-sentinel heal: the fault poisons the params mid-run, a
       # guard trips, the loop rolls back to the last VALIDATED checkpoint
       # and replays — the run completes IN PROCESS (rc 0, no restart),
@@ -253,6 +257,11 @@ EOF
       if ! grep -aq '"event": "sentinel_trip"' "$dir/results"/telemetry_*.jsonl \
          || ! grep -aq '"event": "rollback"' "$dir/results"/telemetry_*.jsonl; then
         fail "$fault" "telemetry missing sentinel_trip/rollback events"; continue
+      fi
+      if [ "$fault" = "opt-moments" ] && ! grep -aq \
+           '"event": "sentinel_trip", .*"kind": "grad_explode"' \
+           "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "opt-moments must trip the GRAD-NORM guard first"; continue
       fi
       if ! validate "$dir"; then
         fail "$fault" "validate_results rejected the healed row (see $dir/validate.log)"

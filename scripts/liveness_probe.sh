@@ -77,6 +77,23 @@ fi
 # No signal yet: startup (or telemetry off) — alive.
 if [ -z "$LAST_JSON" ]; then exit 0; fi
 
+# Live memory pressure (memory-anatomy round): heartbeats carry
+# hbm_peak_gib (and step_window events peak_hbm_bytes) on backends with
+# allocator stats, so an operator watching probe logs sees the HBM
+# high-water mark mid-run instead of only in the post-mortem report.
+# Informational only — memory pressure is the watchdog/sentinel's and
+# the pre-flight estimator's problem, never a liveness verdict.
+HBM_LINE=$(printf '%s' "$LAST_JSON" | python3 -c '
+import json, sys
+e = json.load(sys.stdin)
+gib = e.get("hbm_peak_gib")
+if gib is None and e.get("peak_hbm_bytes") is not None:
+    gib = e["peak_hbm_bytes"] / 2**30
+if gib is not None:
+    print(f"liveness: hbm high-water {float(gib):.2f} GiB")
+' 2>/dev/null) || true
+if [ -n "$HBM_LINE" ]; then echo "$HBM_LINE" >&2; fi
+
 TS=$(printf '%s' "$LAST_JSON" \
      | python3 -c 'import json,sys; print(int(float(json.load(sys.stdin)["ts"])))' \
      2>/dev/null) || exit 0  # torn line mid-write: not evidence of a hang

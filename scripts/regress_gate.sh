@@ -11,6 +11,12 @@
 #   scripts/regress_gate.sh compare last-good latest --arm <arm>
 # Exit codes mirror graftcheck: 0 clean, 1 regression, 2 operational
 # (schema drift, unknown record).
+#
+# The gate's final summary line enumerates the secondary-metric roster
+# it policed (stats.SECONDARY_METRICS — MFU, peak HBM, exposed comms,
+# scaling efficiency, bubble fraction, and the memory-anatomy
+# hbm_model_drift_frac), so a CI transcript is self-describing about
+# what a clean exit actually covered.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 if [ $# -eq 0 ]; then set -- gate --all; fi

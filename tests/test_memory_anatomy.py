@@ -1,0 +1,648 @@
+"""Memory anatomy (analysis/memory_anatomy.py) tier-1 coverage.
+
+Three layers, cheapest first:
+
+- reconciliation math on CPU-synthesized ``memory_analysis()`` /
+  ``memory_stats()`` payloads — attribution books close exactly,
+  reference-source precedence, the xla_temp clamp, drift semantics, and
+  every backend-returns-None fallback path;
+- plumbing: result_fields -> compute_result round trip (unknown-key
+  refusal included), the recorder's per-window bytes-in-use sample +
+  heartbeat ``hbm_peak_gib``, the validator's coherence envelope, and
+  the offline CLI recompute from a stored row;
+- the acceptance proofs: a CPU smoke run emits ``hbm_estimate`` +
+  ``hbm_measured`` (null-with-reason here — the CPU backend has no
+  memory_stats) + the per-class attribution in its result JSON, and an
+  injected drift regression fails a benchreg gate naming
+  ``hbm_model_drift_frac``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from distributed_llm_training_benchmark_framework_tpu.analysis import (
+    memory_anatomy as memano,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GIB = memano.GIB
+
+
+# ---------------------------------------------------------------------------
+# Synthetic payloads
+# ---------------------------------------------------------------------------
+
+
+class _FakeStats:
+    """CPU-synthesized CompiledMemoryStats (the pre-0.4.38 shape: component
+    sizes, no peak_memory_in_bytes attribute)."""
+
+    def __init__(self, arg=0, out=0, temp=0, alias=0, peak=None):
+        self.argument_size_in_bytes = arg
+        self.output_size_in_bytes = out
+        self.temp_size_in_bytes = temp
+        self.alias_size_in_bytes = alias
+        if peak is not None:
+            self.peak_memory_in_bytes = peak
+
+
+class _FakeCompiled:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_analysis(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+class _Est:
+    """A synthesized utils.memory.HBMEstimate (duck-typed)."""
+
+    def __init__(self, params=4 * GIB, grads=4 * GIB, opt_state=8 * GIB,
+                 activations=2 * GIB, logits=1 * GIB, dataset=GIB // 4):
+        self.params = params
+        self.grads = grads
+        self.opt_state = opt_state
+        self.activations = activations
+        self.logits = logits
+        self.dataset = dataset
+
+    @property
+    def total(self):
+        return (self.params + self.grads + self.opt_state
+                + self.activations + self.logits + self.dataset)
+
+    def breakdown(self):
+        return {
+            "params_gib": self.params / GIB,
+            "grads_gib": self.grads / GIB,
+            "opt_state_gib": self.opt_state / GIB,
+            "activations_gib": self.activations / GIB,
+            "logits_gib": self.logits / GIB,
+            "dataset_gib": self.dataset / GIB,
+            "total_gib": self.total / GIB,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Compile-field extraction (incl. the backend-returns-None fallbacks)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_fields_component_form_derives_peak():
+    fields = memano.compile_memory_fields(
+        _FakeCompiled(_FakeStats(arg=100, out=100, temp=50, alias=90))
+    )
+    assert fields["argument_bytes"] == 100
+    assert fields["temp_bytes"] == 50
+    # args + out + temp - alias: the buffer-assignment quantity.
+    assert fields["peak_bytes"] == 160
+
+
+def test_compile_fields_prefers_explicit_peak():
+    fields = memano.compile_memory_fields(
+        _FakeCompiled(_FakeStats(arg=100, out=100, temp=50, alias=90,
+                                 peak=175))
+    )
+    assert fields["peak_bytes"] == 175
+
+
+@pytest.mark.parametrize("compiled", [
+    None,                                        # no executable at all
+    _FakeCompiled(RuntimeError("not supported")),  # backend raises
+    _FakeCompiled(None),                         # analysis returns None
+    _FakeCompiled(_FakeStats()),                 # all-zero stats object
+])
+def test_compile_fields_backend_fallbacks_return_none(compiled):
+    assert memano.compile_memory_fields(compiled) is None
+
+
+def test_measured_peak_null_with_reason_when_no_memory_stats(monkeypatch):
+    from distributed_llm_training_benchmark_framework_tpu.utils import (
+        metrics as metrics_mod,
+    )
+
+    monkeypatch.setattr(metrics_mod, "peak_hbm_bytes", lambda: None)
+    val, reason = memano.measured_peak_bytes()
+    assert val is None and "memory_stats" in reason
+
+
+def test_measured_peak_shared_process_guard(monkeypatch):
+    from distributed_llm_training_benchmark_framework_tpu.utils import (
+        metrics as metrics_mod,
+    )
+
+    monkeypatch.setattr(metrics_mod, "peak_hbm_bytes", lambda: 1000)
+    # An earlier arm already raised the process mark to >= this value:
+    # the allocator cannot answer for THIS arm.
+    val, reason = memano.measured_peak_bytes(prior_peak_bytes=1000)
+    assert val is None and "predates" in reason
+    val, reason = memano.measured_peak_bytes(prior_peak_bytes=400)
+    assert val == 1000 and reason == "allocator"
+
+
+# ---------------------------------------------------------------------------
+# Reconciliation math
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_books_close_exactly_on_measured_peak():
+    est = _Est()
+    compile_mem = {
+        "argument_bytes": 12 * GIB, "output_bytes": 12 * GIB,
+        "temp_bytes": 8 * GIB, "alias_bytes": 12 * GIB,
+        "peak_bytes": 20 * GIB,
+    }
+    measured = 21 * GIB
+    rep = memano.reconcile(est, compile_mem=compile_mem,
+                           measured_bytes=measured,
+                           measured_reason="allocator")
+    assert rep["reference_source"] == "allocator"
+    assert rep["reference_bytes"] == measured
+    attr = rep["attribution_bytes"]
+    # The defining invariant: classes + signed residual == reference.
+    assert sum(attr.values()) == measured
+    # xla_temp = compiler temps the model did NOT predict
+    # (8 GiB - (grads 4 + activations 2 + logits 1)) = 1 GiB.
+    assert attr["xla_temp"] == 1 * GIB
+    # logits fold into activations.
+    assert attr["activations"] == 3 * GIB
+    # drift = |21 - 19.25| / 19.25.
+    assert rep["drift_frac"] == pytest.approx((21 - 19.25) / 19.25)
+
+
+def test_reconcile_xla_temp_clamps_at_zero():
+    est = _Est()
+    compile_mem = {
+        "argument_bytes": 1, "output_bytes": 1,
+        "temp_bytes": 2 * GIB,  # below predicted grads+activations
+        "alias_bytes": 0, "peak_bytes": 18 * GIB,
+    }
+    rep = memano.reconcile(est, compile_mem=compile_mem)
+    assert rep["attribution_bytes"]["xla_temp"] == 0
+    # Books still close on the xla reference, residual signed negative.
+    assert rep["reference_source"] == "xla_buffer_assignment"
+    assert sum(rep["attribution_bytes"].values()) == 18 * GIB
+    assert rep["attribution_bytes"]["unattributed"] < 0
+
+
+def test_reconcile_analytic_fallback_claims_no_drift():
+    rep = memano.reconcile(_Est(), compile_mem=None, measured_bytes=None,
+                           measured_reason="backend exposes no memory_stats()")
+    assert rep["reference_source"] == "analytic"
+    assert rep["drift_frac"] is None  # a model cannot drift from itself
+    assert sum(rep["attribution_bytes"].values()) == _Est().total
+    assert rep["attribution_bytes"]["unattributed"] == 0
+
+
+def test_reconcile_prefers_measured_over_compile_peak():
+    compile_mem = {"argument_bytes": 0, "output_bytes": 0,
+                   "temp_bytes": 0, "alias_bytes": 0, "peak_bytes": 5 * GIB}
+    rep = memano.reconcile(_Est(), compile_mem=compile_mem,
+                           measured_bytes=22 * GIB,
+                           measured_reason="allocator")
+    assert rep["reference_source"] == "allocator"
+    assert rep["reference_bytes"] == 22 * GIB
+
+
+# ---------------------------------------------------------------------------
+# result_fields -> compute_result round trip
+# ---------------------------------------------------------------------------
+
+
+def _result_kwargs(**over):
+    kw = dict(
+        strategy="ddp", world_size=1, rank=0, seq_len=32, tier="S",
+        steps=10, per_device_batch=1, grad_accum=1,
+        step_times=[0.1] * 8, losses=[5.0] * 8,
+    )
+    kw.update(over)
+    return kw
+
+
+def test_result_fields_ride_compute_result():
+    from distributed_llm_training_benchmark_framework_tpu.utils import (
+        metrics as metrics_mod,
+    )
+
+    est = _Est()
+    rep = memano.reconcile(est, measured_bytes=21 * GIB,
+                           measured_reason="allocator")
+    fields = memano.result_fields(rep, est_breakdown=est.breakdown())
+    result = metrics_mod.compute_result(
+        **_result_kwargs(memory_anatomy=fields)
+    )
+    assert result.hbm_measured == pytest.approx(21.0)
+    assert result.hbm_measured_reason == "allocator"
+    assert result.hbm_attribution_source == "allocator"
+    assert result.hbm_estimate["total_gib"] == pytest.approx(19.25)
+    assert result.hbm_model_drift_frac == pytest.approx(
+        (21 - 19.25) / 19.25, abs=1e-4
+    )
+    # Attribution classes survive as a dict on the row.
+    assert set(result.hbm_attribution) == set(memano.ATTRIBUTION_CLASSES)
+
+
+def test_compute_result_refuses_unknown_memory_keys():
+    from distributed_llm_training_benchmark_framework_tpu.utils import (
+        metrics as metrics_mod,
+    )
+
+    with pytest.raises(ValueError, match="unknown memory_anatomy keys"):
+        metrics_mod.compute_result(
+            **_result_kwargs(memory_anatomy={"hbm_totally_new_key": 1.0})
+        )
+
+
+def test_absent_memory_anatomy_leaves_row_nulls():
+    from distributed_llm_training_benchmark_framework_tpu.utils import (
+        metrics as metrics_mod,
+    )
+
+    result = metrics_mod.compute_result(**_result_kwargs())
+    assert result.hbm_estimate is None
+    assert result.hbm_measured is None
+    assert result.hbm_attribution is None
+    assert result.hbm_model_drift_frac is None
+
+
+# ---------------------------------------------------------------------------
+# Offline CLI recompute from a stored row
+# ---------------------------------------------------------------------------
+
+
+def test_offline_recompute_matches_live_fields(tmp_path, capsys):
+    est = _Est()
+    live = memano.result_fields(
+        memano.reconcile(est, measured_bytes=21 * GIB,
+                         measured_reason="allocator"),
+        est_breakdown=est.breakdown(),
+    )
+    row = dict(live, strategy="ddp")
+    path = tmp_path / "result_fake.json"
+    path.write_text(json.dumps(row))
+    rc = memano.main(["--result", str(path), "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    # The offline recompute has no compile-time source, so xla_temp
+    # vanishes into the residual — but the measured reference, drift and
+    # the analytic classes must agree with the live fields.
+    assert out["hbm_model_drift_frac"] == live["hbm_model_drift_frac"]
+    assert out["hbm_reference_gib"] == live["hbm_reference_gib"]
+    for cls in ("params", "grads", "opt_state", "dataset"):
+        assert out["hbm_attribution"][cls] == pytest.approx(
+            live["hbm_attribution"][cls], abs=2e-4
+        )
+
+
+def test_offline_recompute_rebuilds_xla_reference(tmp_path, capsys):
+    # The CPU-dryrun shape: no measured peak, reference = XLA buffer
+    # assignment. The offline recompute must rebuild that reference from
+    # the persisted hbm_reference_gib + xla_temp instead of silently
+    # falling back to the analytic one (which would contradict the
+    # stored, gate-fed drift).
+    est = _Est()
+    compile_mem = {
+        "argument_bytes": 12 * GIB, "output_bytes": 12 * GIB,
+        "temp_bytes": 8 * GIB, "alias_bytes": 12 * GIB,
+        "peak_bytes": 20 * GIB,
+    }
+    live = memano.result_fields(
+        memano.reconcile(est, compile_mem=compile_mem, measured_bytes=None,
+                         measured_reason="backend exposes no memory_stats()"),
+        est_breakdown=est.breakdown(),
+    )
+    assert live["hbm_attribution_source"] == "xla_buffer_assignment"
+    path = tmp_path / "result_xla.json"
+    path.write_text(json.dumps(dict(live, strategy="ddp")))
+    assert memano.main(["--result", str(path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["hbm_attribution_source"] == "xla_buffer_assignment"
+    assert out["hbm_model_drift_frac"] == live["hbm_model_drift_frac"]
+    assert out["hbm_attribution"]["xla_temp"] == pytest.approx(
+        live["hbm_attribution"]["xla_temp"], abs=2e-4
+    )
+    assert out["hbm_reference_gib"] == live["hbm_reference_gib"]
+
+
+def test_offline_recompute_refuses_pre_anatomy_rows(tmp_path):
+    path = tmp_path / "result_old.json"
+    path.write_text(json.dumps({"strategy": "ddp", "tokens_per_sec": 1.0}))
+    assert memano.main(["--result", str(path)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# Recorder: per-window bytes-in-use sample + heartbeat hbm_peak_gib
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_samples_hbm_and_heartbeats_peak(tmp_path, monkeypatch,
+                                                  capsys):
+    from distributed_llm_training_benchmark_framework_tpu.telemetry import (
+        TelemetryRecorder,
+        parse_heartbeat_line,
+        read_events,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.utils import (
+        metrics as metrics_mod,
+    )
+
+    monkeypatch.setattr(metrics_mod, "peak_hbm_bytes",
+                        lambda: 3 * 2**30)
+    monkeypatch.setattr(metrics_mod, "hbm_bytes_in_use",
+                        lambda: 2 * 2**30)
+    rec = TelemetryRecorder(
+        "memarm", results_dir=str(tmp_path), heartbeat_every_sec=0.0,
+        tokens_per_step=10,
+    )
+    rec.begin_phase("init")
+    rec.step_window(last_step=0, losses=[5.0],
+                    window_mean_step_time_sec=0.1)
+    rec.close("ok")
+    events = read_events(str(tmp_path / "telemetry_memarm.jsonl"))
+    w = [e for e in events if e["event"] == "step_window"][0]
+    assert w["peak_hbm_bytes"] == 3 * 2**30
+    assert w["hbm_bytes_in_use"] == 2 * 2**30
+    hb = [parse_heartbeat_line(l) for l in capsys.readouterr().out.splitlines()
+          if parse_heartbeat_line(l)]
+    assert hb and hb[0]["hbm_peak_gib"] == pytest.approx(3.0)
+
+
+def test_recorder_omits_hbm_fields_on_cpu(tmp_path, capsys):
+    # The real CPU backend: peak_hbm_bytes() is None — the heartbeat must
+    # simply omit the key, never carry a fake zero.
+    from distributed_llm_training_benchmark_framework_tpu.telemetry import (
+        TelemetryRecorder,
+        parse_heartbeat_line,
+    )
+
+    rec = TelemetryRecorder(
+        "memarm2", results_dir=str(tmp_path), heartbeat_every_sec=0.0,
+        tokens_per_step=10,
+    )
+    rec.begin_phase("init")
+    rec.step_window(last_step=0, losses=[5.0],
+                    window_mean_step_time_sec=0.1)
+    rec.close("ok")
+    hb = [parse_heartbeat_line(l) for l in capsys.readouterr().out.splitlines()
+          if parse_heartbeat_line(l)]
+    assert hb and "hbm_peak_gib" not in hb[0]
+
+
+def test_liveness_probe_surfaces_hbm_pressure():
+    text = open(os.path.join(REPO, "scripts", "liveness_probe.sh")).read()
+    assert "hbm_peak_gib" in text
+    assert "hbm high-water" in text
+
+
+# ---------------------------------------------------------------------------
+# Validator envelope
+# ---------------------------------------------------------------------------
+
+
+def _valid_row(**over):
+    est = _Est()
+    fields = memano.result_fields(
+        memano.reconcile(est, measured_bytes=21 * GIB,
+                         measured_reason="allocator"),
+        est_breakdown=est.breakdown(),
+    )
+    row = {
+        "strategy": "zero2", "world_size": 1, "seq_len": 2048, "tier": "A",
+        "steps": 10, "tokens_per_sec": 1000.0, "mean_step_time_sec": 0.1,
+        "mean_loss": 5.0, "peak_vram_gb": 1.0, "h2d_gbps_per_gpu": 0.1,
+        **fields,
+    }
+    row.update(over)
+    return row
+
+
+def test_validator_accepts_coherent_memory_row():
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results as vr,
+    )
+
+    assert [v for v in vr.validate_result(_valid_row(), "r")
+            if "hbm" in v] == []
+
+
+@pytest.mark.parametrize("mutation, needle", [
+    ({"hbm_estimate": None}, "coexist"),
+    ({"hbm_measured": None, "hbm_measured_reason": ""}, "say why"),
+    ({"hbm_model_drift_frac": None}, "drift"),
+    ({"hbm_reference_gib": 40.0}, "close the books"),
+])
+def test_validator_rejects_incoherent_memory_rows(mutation, needle):
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results as vr,
+    )
+
+    failures = vr.validate_result(_valid_row(**mutation), "r")
+    assert any(needle in v for v in failures), failures
+
+
+def test_validator_rejects_negative_attribution_class():
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results as vr,
+    )
+
+    row = _valid_row()
+    attr = dict(row["hbm_attribution"])
+    delta = attr["params"] + 1.0
+    attr["params"] = -1.0
+    attr["unattributed"] += delta  # books still close — the sign is the bug
+    row["hbm_attribution"] = attr
+    failures = vr.validate_result(row, "r")
+    assert any("negative" in v and "params" in v for v in failures), failures
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: CPU smoke emits the fields end-to-end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def smoke_run(tmp_path_factory):
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import (
+        run_benchmark,
+    )
+
+    tmp = tmp_path_factory.mktemp("memsmoke")
+    result = run_benchmark(
+        strategy=get_strategy("ddp"), tier="S", seq_len=32, steps=6,
+        warmup_steps=1, per_device_batch=1, grad_accum=1, world_size=1,
+        results_dir=str(tmp), sync_every=2, telemetry=True,
+        heartbeat_sec=0,
+    )
+    return tmp, result
+
+
+def test_smoke_result_json_carries_memory_anatomy(smoke_run):
+    tmp, result = smoke_run
+    row = json.load(open(tmp / "result_ddp_ws1_seq32_tierS.json"))
+    # The acceptance triple: estimate breakdown, explicit
+    # null-with-reason measurement (CPU has no memory_stats), and the
+    # per-class attribution.
+    assert row["hbm_estimate"]["total_gib"] > 0
+    assert row["hbm_measured"] is None
+    assert "memory_stats" in row["hbm_measured_reason"]
+    assert set(row["hbm_attribution"]) == set(memano.ATTRIBUTION_CLASSES)
+    # On CPU the reference is XLA's buffer assignment (memory_analysis
+    # works even here), so the attribution is measured, not analytic.
+    assert row["hbm_attribution_source"] == "xla_buffer_assignment"
+    assert row["hbm_model_drift_frac"] is not None
+    total = sum(row["hbm_attribution"].values())
+    assert total == pytest.approx(row["hbm_reference_gib"], abs=5e-3)
+
+
+def test_smoke_telemetry_carries_memory_anatomy_event(smoke_run):
+    from distributed_llm_training_benchmark_framework_tpu.telemetry import (
+        read_events,
+    )
+
+    tmp, _ = smoke_run
+    events = read_events(str(tmp / "telemetry_ddp_ws1_seq32_tierS.jsonl"))
+    mem = [e for e in events if e["event"] == "memory_anatomy"]
+    assert len(mem) == 1
+    assert mem[0]["hbm_attribution_source"] == "xla_buffer_assignment"
+
+
+def test_smoke_passes_validator(smoke_run):
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results as vr,
+    )
+
+    tmp, _ = smoke_run
+    failures, n = vr.collect(str(tmp), None)
+    assert n >= 1 and failures == [], failures
+
+
+def test_smoke_parse_metrics_flattens_attribution(smoke_run):
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        parse_metrics,
+    )
+
+    tmp, _ = smoke_run
+    df = parse_metrics.load_results(str(tmp))
+    for cls in memano.ATTRIBUTION_CLASSES:
+        assert f"hbm_attr_{cls}" in df.columns
+    assert "hbm_est_total_gib" in df.columns
+    assert "hbm_attribution" not in df.columns  # dicts never reach the csv
+
+
+def test_smoke_report_renders_memory_section(smoke_run):
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        make_report,
+        parse_metrics,
+    )
+
+    tmp, _ = smoke_run
+    df = parse_metrics.add_scaling_efficiency(
+        parse_metrics.load_results(str(tmp))
+    )
+    md = make_report.build_report(df)
+    assert "## Memory anatomy (HBM peak, attributed)" in md
+    assert "xla_buffer_assignment" in md
+
+
+def test_smoke_telemetry_report_renders_hbm_timeline(monkeypatch):
+    # Synthesized windows (CPU step_windows carry null HBM): the timeline
+    # renders the sparkline + high-water step from the samples alone.
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        telemetry_report,
+    )
+
+    windows = [
+        {"step": s, "peak_hbm_bytes": int((2 + 0.1 * s) * 2**30),
+         "hbm_bytes_in_use": int(1.5 * 2**30)}
+        for s in range(5)
+    ]
+    lines = telemetry_report.hbm_timeline_lines(windows)
+    assert lines and "high-water" in lines[0]
+    assert "@ step 4" in lines[0]
+    assert any("bytes-in-use" in l for l in lines)
+    assert telemetry_report.hbm_timeline_lines(
+        [{"step": 0, "peak_hbm_bytes": None}]
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: hbm_model_drift_frac gates as a benchreg secondary
+# ---------------------------------------------------------------------------
+
+
+def _drift_record(store_mod, arm, tps, drift):
+    row = {
+        "strategy": "zero2", "world_size": 1, "seq_len": 2048, "tier": "A",
+        "tokens_per_sec": tps, "mean_step_time_sec": 0.05,
+        "mean_loss": 5.0, "peak_vram_gb": 1.0, "h2d_gbps_per_gpu": 0.1,
+        "hbm_model_drift_frac": drift,
+    }
+    return store_mod.make_record(arm=arm, result_row=row, status="ok",
+                                 source=f"test:{tps}:{drift}")
+
+
+def test_drift_metric_is_registered_secondary():
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        stats,
+    )
+
+    entry = [m for m in stats.SECONDARY_METRICS
+             if m[0] == "hbm_model_drift_frac"]
+    assert entry == [("hbm_model_drift_frac", False, 5.0, "abs_pp")]
+
+
+def test_injected_drift_regression_fails_gate_by_name(tmp_path):
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        compare,
+        stats,
+        store,
+    )
+
+    reg = store.Registry(str(tmp_path / "reg"))
+    # Three same-config history runs (distinct values — identical rows
+    # content-hash dedupe) teach the noise floor; the candidate
+    # quadruples the drift while the primary stays flat.
+    for drift in (0.02, 0.03, 0.025):
+        reg.ingest(_drift_record(store, "a", 1000.0, drift))
+    reg.ingest(_drift_record(store, "a", 1000.0, 0.40))
+    verdict, line = compare.gate_arm(reg, "a")
+    assert verdict == stats.VERDICT_REGRESSION
+    assert "hbm_model_drift_frac" in line, line
+
+
+def test_aa_drift_stays_quiet(tmp_path):
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        compare,
+        stats,
+        store,
+    )
+
+    reg = store.Registry(str(tmp_path / "reg"))
+    for drift in (0.02, 0.03, 0.025, 0.022):
+        reg.ingest(_drift_record(store, "a", 1000.0, drift))
+    verdict, line = compare.gate_arm(reg, "a")
+    assert verdict != stats.VERDICT_REGRESSION, line
+
+
+def test_gate_summary_names_the_secondary_roster(tmp_path, capsys):
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        compare,
+        store,
+    )
+
+    reg = store.Registry(str(tmp_path / "reg"))
+    reg.ingest(_drift_record(store, "a", 1000.0, 0.02))
+    rc = compare.main(["--registry", str(tmp_path / "reg"), "gate", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "secondaries gated:" in out
+    assert "hbm_model_drift_frac" in out

@@ -736,3 +736,97 @@ def test_k8s_template_and_launcher_plumb_hang_timeout():
     # The launcher refuses a watchdog timeout at/above the probe grace —
     # the watchdog must always win the race against the pod kill.
     assert "PROBE_GRACE" in launcher
+
+
+# ---------------------------------------------------------------------------
+# opt-moments: the grad-norm-guard fault spec (ROADMAP carry-forward)
+# ---------------------------------------------------------------------------
+
+
+def test_opt_moments_spec_grammar():
+    s = faults.parse_fault_spec("opt-moments@6")
+    assert (s.kind, s.step, s.rank, s.hang_sec) == ("opt-moments", 6,
+                                                    None, None)
+    assert str(s) == "opt-moments@6"
+    assert "opt-moments" in faults.FAULT_KINDS
+    for bad in ("opt-moments", "opt-moments@2:1"):
+        with pytest.raises(ValueError):
+            faults.parse_fault_spec(bad)
+
+
+def test_opt_moments_corrupts_only_nu_and_mu_fields():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_llm_training_benchmark_framework_tpu.faults import (
+        injection,
+    )
+
+    params = {"w": jnp.ones((4,)), "nu": jnp.ones((4,))}  # decoy key name
+    opt_state = optax.adamw(1e-3).init(params)
+    opt_state = jax.tree.map(
+        lambda x: x + 1.0 if x.ndim else x, opt_state
+    )
+    inj = injection.FaultInjector(
+        injection.parse_fault_spec("opt-moments@3"), is_main=False
+    )
+    out = inj.corrupt_opt_state(3, opt_state)
+    assert inj.fired
+    adam = out[0]
+    assert float(adam.nu["w"][0]) == pytest.approx(
+        injection.MOMENT_COLLAPSE_SCALE, rel=1e-3
+    )
+    assert float(adam.mu["w"][0]) == pytest.approx(
+        injection.MOMENT_BURST_SCALE, rel=1e-3
+    )
+    # A params key literally named 'nu' sits under BOTH moment subtrees
+    # (mu['nu'], nu['nu']) — corrupted as moments, which is correct; the
+    # count stays untouched (it is not under a moment field).
+    assert int(adam.count) == int(opt_state[0].count)
+    # Armed-at-a-different-step and unarmed injectors are passthrough.
+    inj2 = injection.FaultInjector(
+        injection.parse_fault_spec("opt-moments@5"), is_main=False
+    )
+    assert inj2.corrupt_opt_state(3, opt_state) is opt_state
+    inert = injection.FaultInjector(None, is_main=False)
+    assert inert.corrupt_opt_state(3, opt_state) is opt_state
+
+
+def test_opt_moments_trips_grad_norm_guard_first_and_heals(tmp_path):
+    """The ROADMAP carry-forward pin: before this spec no fault tripped
+    the grad-norm guard ahead of the loss/checksum guards. opt-moments
+    corrupts the Adam moment buffers at step 9; step 9's own loss/grads
+    stay healthy (the poison enters through the update), step 10's
+    global grad-norm explodes while its loss is loudly finite, the
+    sentinel trips ``grad_explode`` — and ONLY ``grad_explode`` — and
+    the run heals with one rollback to the validated checkpoint."""
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import (
+        run_benchmark,
+    )
+
+    result = run_benchmark(
+        strategy=get_strategy("ddp"), tier="S", seq_len=32, steps=14,
+        warmup_steps=2, per_device_batch=1, grad_accum=1, world_size=1,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=4,
+        sync_every=2, sentinel=True,
+        inject_fault="opt-moments@9", telemetry=True, heartbeat_sec=0,
+    )
+    assert result.n_rollbacks == 1
+    assert result.rollback_steps_replayed >= 1
+    events = [json.loads(l) for l in
+              open(tmp_path / "results" / f"telemetry_{ARM}.jsonl")]
+    trips = [e for e in events if e["event"] == "sentinel_trip"]
+    assert [e["kind"] for e in trips] == ["grad_explode"], trips
+    # The spike detail (not the non-finite branch): the guard measured
+    # the explosion against its rolling median.
+    assert "rolling median" in trips[0]["detail"]
+    # ...and it tripped at the step AFTER the injection: the corrupted
+    # step itself observed clean.
+    assert trips[0]["step"] == 10
+    fault = [e for e in events if e["event"] == "fault_injected"]
+    assert fault and "opt-moments" in fault[0]["fault"]
