@@ -135,6 +135,10 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
             checkpoint_every=args.checkpoint_every if use_checkpoint else 0,
             checkpoint_async=args.checkpoint_async and use_checkpoint,
             profile_dir=profile_dir,
+            # Streaming data path (off by default — the headline stays the
+            # zero-IO synthetic table, contract row byte-identical).
+            data_path=args.data_path,
+            data_stall_timeout_sec=args.data_stall_timeout_sec,
         )
     per_chip = result.tokens_per_sec / world
     row_extra = {}
@@ -158,6 +162,17 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
                 "comms_overlap_frac", "anatomy_idle_frac", "bubble_frac",
                 "roofline_flops_pct_of_peak", "roofline_hbm_pct_of_peak",
             ) if getattr(result, k) is not None
+        })
+    if result.data_mode == "stream":
+        # Streaming-data columns (additive, stream arms only): the
+        # data_stall_frac rides into the registry result row, where the
+        # gate verdicts it beside the other SECONDARY_METRICS — and the
+        # data_mode key splits stream arms into their own lineage so a
+        # streamed run never cross-gates against the synthetic headline.
+        row_extra.update({
+            "data_mode": result.data_mode,
+            "data_stall_frac": result.data_stall_frac,
+            "records_skipped": result.records_skipped,
         })
     if result.hbm_attribution is not None:
         # Memory-anatomy columns (analysis/memory_anatomy.py): the
@@ -275,6 +290,12 @@ def build_parser():
     # rides the compute/exposed-comms/idle + roofline fields into the row
     # — and so into the registry, where they gate as secondary metrics.
     p.add_argument("--profile-dir", default=None)
+    p.add_argument("--data-path", default=None,
+                   help="tokenized record shards for the streaming input "
+                        "path (data/stream.py); default: synthetic table")
+    p.add_argument("--data-stall-timeout-sec", type=float, default=60.0,
+                   help="with --data-path: abort as reason=data_stall "
+                        "past this input starvation (exit 78)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
     p.add_argument("--checkpoint-async", action="store_true",

@@ -501,18 +501,27 @@ def build_report(
         # arm left an emergency checkpoint and resumes on retry; a hung
         # arm was aborted by the in-process watchdog (exit 76, stack dump
         # in its telemetry hang_dump event) and also resumes on retry; a
-        # crashed one needs triage. The collect script stamps `reason`
+        # crashed one needs triage. An input-starved arm (streaming
+        # round) was classified reason=data_stall by the loop itself
+        # (exit 78, emergency checkpoint + stream sidecar — resumes on
+        # retry like a preemption, but the triage target is the DATA
+        # source, not the device). The collect script stamps `reason`
         # from the final heartbeat (emergency heartbeats carry
-        # reason=preempted|hang).
+        # reason=preempted|hang|data_stall).
         death = ""
         if "reason" in df.columns:
             reasons = df.loc[is_partial, "reason"]
             n_pre = int((reasons == "preempted").sum())
             n_hang = int((reasons == "hang").sum())
+            n_stall = int((reasons == "data_stall").sum())
+            stall_txt = (
+                f"{n_stall} input-starved (data_stall: checkpointed, "
+                "triage the data source), " if n_stall else ""
+            )
             death = (f" ({n_pre} preempted with an emergency checkpoint, "
                      f"{n_hang} hung (watchdog abort, stack dump in "
-                     "telemetry), "
-                     f"{n_partial - n_pre - n_hang} crashed)")
+                     "telemetry), " + stall_txt +
+                     f"{n_partial - n_pre - n_hang - n_stall} crashed)")
         out.append(
             f"- **Partial rows:** {n_partial} arm(s) died before their "
             "final result marker; their rows come from heartbeat salvage "

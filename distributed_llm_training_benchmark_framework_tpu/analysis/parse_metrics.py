@@ -59,6 +59,12 @@ def _partial_row(p: dict) -> dict:
             "attention_impl", "tensor_parallel", "sequence_parallel",
             "pipeline_parallel", "pipeline_schedule", "expert_parallel",
             "n_experts", "causal", "ring_zigzag",
+            # Streaming-data progress (stream runs stamp these on every
+            # heartbeat): a salvaged input-starved arm keeps its honest
+            # stall/skip accounting AND its stream lineage identity in
+            # the partial row (store.config_key reads data_mode — a dead
+            # stream arm must not be misfiled into the synthetic lineage).
+            "data_mode", "data_stall_frac", "records_skipped",
         ) if k in p
     }
     if "total_steps" in p:

@@ -41,6 +41,16 @@ deployment invariant this codebase has already paid for once:
          axis outside the site's fully-literal ``axis_names`` set: the
          bad axis only raises at trace time, deep inside a jit. Sites
          whose axis set is not fully static are skipped, never guessed.
+- GC111  blocking file IO (``open``/``.read()``/``.seek()``-class),
+         host-iterator ``next()`` pulls, or ``time.sleep`` inside a
+         timed ``for step`` loop in ``data/`` or ``train/`` with no
+         sync_window fence earlier in the block and outside the
+         prefetch fence: the streaming data path's ONE sanctioned
+         blocking pull is the prefetcher's ``get()`` (receiver named
+         ``*prefetch*``) — any other host read inside the loop
+         serializes input IO into the very step times the loop
+         publishes (the regression ``data_stall_frac`` exists to
+         measure, not to hide).
 - GC109  ``with_sharding_constraint``/``device_put``/host-sync calls
          inside a per-microbatch Python loop (``for _ in range(...)``)
          in ``parallel/``: the pipeline tick loops unroll at trace time,
@@ -455,6 +465,78 @@ def _check_timed_loop_signal_and_blocking_io(root: str) -> Iterator[Violation]:
                 "block",
                 RULES["GC106"].fix_hint,
             )
+
+
+# ---------------------------------------------------------------------------
+# GC111: blocking input IO / host-iterator pulls in the timed loop
+# ---------------------------------------------------------------------------
+
+#: Dotted-name calls GC111 classifies as blocking input IO. ``next`` is
+#: the host-iterator pull (a DataLoader-style ``next(it)`` inside the
+#: loop is exactly the serialization the prefetcher exists to remove);
+#: ``time.sleep`` is an explicit stall.
+_GC111_IO_NAMES = frozenset({
+    "open", "io.open", "os.read", "os.pread", "time.sleep",
+})
+#: Attribute calls (``f.read()``/``f.seek()``-class) GC111 flags unless
+#: the receiver is the sanctioned prefetch surface.
+_GC111_ATTR_IO = frozenset({
+    "read", "readline", "readlines", "readinto", "seek",
+})
+
+
+def _is_blocking_data_io(call: ast.Call) -> Optional[str]:
+    """Classify a call as loop-hostile input IO, or None.
+
+    The prefetch fence: any call whose receiver name mentions
+    ``prefetch`` is the sanctioned blocking pull (data/prefetch.py
+    ``HostPrefetcher.get`` — it measures its own wait into
+    ``data_stall_frac``) and is never flagged.
+    """
+    name = _dotted(call.func)
+    if name in _GC111_IO_NAMES:
+        return f"{name}() blocking host IO"
+    if name == "next" and call.args:
+        return "next() host-iterator pull"
+    if isinstance(call.func, ast.Attribute):
+        recv = _dotted(call.func.value) or ""
+        if "prefetch" in recv.lower():
+            return None  # the sanctioned fence itself
+        if call.func.attr in _GC111_ATTR_IO:
+            return f".{call.func.attr}() blocking file IO"
+    return None
+
+
+@_rule(
+    "GC111",
+    "blocking-input-io-in-timed-loop",
+    "blocking file IO / host-iterator next() / time.sleep inside a timed "
+    "`for step` loop in data/ or train/ with no sync_window fence earlier "
+    "in its block and outside the prefetch fence — input IO serialized "
+    "into the timed loop lands inside the very step times the loop "
+    "publishes (the starvation data_stall_frac exists to MEASURE)",
+    "pull batches through the host prefetcher (data/prefetch.py "
+    "HostPrefetcher.get — the sanctioned, wait-measured fence), or move "
+    "the IO behind a sync_window fence; suppress deliberate exceptions "
+    "with '# graftcheck: disable=GC111'",
+)
+def _check_timed_loop_blocking_input_io(root: str) -> Iterator[Violation]:
+    for tree in _package_files(root, ("data", "train")):
+        # Same fence walk as GC105/GC106 (shared _iter_timed_loop_calls):
+        # a sync_window earlier in the block fences what follows; files
+        # without a sync_window helper simply never fence.
+        for call, fenced in _iter_timed_loop_calls(tree):
+            if fenced:
+                continue
+            kind = _is_blocking_data_io(call)
+            if kind and not _suppressed(tree, call.lineno, "GC111"):
+                yield Violation(
+                    "GC111", tree.rel, call.lineno,
+                    f"{kind} inside the timed step loop with no "
+                    "sync_window fence earlier in its block (and outside "
+                    "the prefetch fence)",
+                    RULES["GC111"].fix_hint,
+                )
 
 
 # ---------------------------------------------------------------------------

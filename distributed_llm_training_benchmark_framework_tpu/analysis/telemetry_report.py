@@ -65,6 +65,7 @@ def build_timeline(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     intervals: List[Dict[str, Any]] = []
     windows: List[Dict[str, Any]] = []
     anomalies: List[Dict[str, Any]] = []
+    data_events: List[Dict[str, Any]] = []
     end: Optional[Dict[str, Any]] = None
     open_iv: Optional[Dict[str, Any]] = None
     last_rel = 0.0
@@ -87,6 +88,8 @@ def build_timeline(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             windows.append(e)
         elif kind in ("anomaly", "anomaly_resolved"):
             anomalies.append(e)
+        elif kind in ("data_stall", "data_corrupt_record"):
+            data_events.append(e)
         elif kind in ("run_end", "run_aborted"):
             end = e
     for iv in intervals:
@@ -101,7 +104,8 @@ def build_timeline(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     wall = float(end.get("wall_time_total_sec", last_rel)) if end else last_rel
     return {
         "meta": meta, "intervals": intervals, "phase_times": phase_times,
-        "windows": windows, "anomalies": anomalies, "end": end,
+        "windows": windows, "anomalies": anomalies,
+        "data_events": data_events, "end": end,
         "wall": wall,
     }
 
@@ -143,6 +147,68 @@ def hbm_timeline_lines(
         out.append(
             f"    live bytes-in-use: last {in_use[-1] / 2**30:.2f} GiB "
             f"({100.0 * in_use[-1] / hi:.0f}% of the high-water mark)"
+        )
+    return out
+
+
+def data_stall_timeline_lines(
+    events: List[Dict[str, Any]],
+    windows: List[Dict[str, Any]],
+    width: int = 44,
+) -> List[str]:
+    """The input-starvation timeline across a run's sync windows.
+
+    Streaming round: stream runs stamp each ``step_window`` with
+    ``data_wait_sec`` (the loop's measured wait for that window's
+    batches), so the stall trajectory sits beside the HBM high-water line
+    in the same JSONL-only report. Renders a sparkline of the per-window
+    wait fraction plus the totals, the quarantine count, and any
+    ``data_stall`` events (non-fatal window stalls and the fatal
+    classification). Empty list for synthetic runs (no window carries the
+    field).
+    """
+    pts = []
+    for w in windows:
+        wait = w.get("data_wait_sec")
+        if wait is None:
+            continue
+        wall = (
+            (w.get("window_mean_step_time_sec") or 0.0)
+            * (w.get("steps_in_window") or 1)
+        )
+        pts.append((w.get("step"), float(wait), wall))
+    if not pts:
+        return []
+    levels = " .:-=+*#%@"
+    fracs = [min(wait / wall, 1.0) if wall > 0 else 0.0
+             for _s, wait, wall in pts]
+    spark = "".join(
+        levels[min(int(fr * (len(levels) - 1)), len(levels) - 1)]
+        for fr in fracs[-width:]
+    )
+    total_wait = sum(wait for _s, wait, _w in pts)
+    total_wall = sum(wall for _s, _w2, wall in pts)
+    frac = total_wait / total_wall if total_wall > 0 else 0.0
+    out = [
+        f"  Data-stall timeline ({len(pts)} sampled windows): "
+        f"{total_wait:.2f}s waiting on input over {total_wall:.2f}s of "
+        f"windows ({100.0 * frac:.1f}%)",
+        f"    |{spark}|",
+    ]
+    stalls = [e for e in events if e.get("event") == "data_stall"]
+    if stalls:
+        fatal = [e for e in stalls if e.get("fatal")]
+        out.append(
+            f"    data_stall events: {len(stalls)}"
+            + (f" (FATAL at step {fatal[-1].get('step')} — run classified "
+               "reason=data_stall)" if fatal else " (all transient)")
+        )
+    skipped = [w.get("records_skipped") for w in windows
+               if w.get("records_skipped") is not None]
+    if skipped and skipped[-1]:
+        out.append(
+            f"    records skipped/quarantined: {skipped[-1]} "
+            "(data_corrupt_record events carry the ledger)"
         )
     return out
 
@@ -222,6 +288,7 @@ def format_report(tl: Dict[str, Any]) -> str:
         if hbm:
             out.append(f"  peak HBM (allocator): {max(hbm) / 1e9:.2f} GB")
         out.extend(hbm_timeline_lines(ws))
+        out.extend(data_stall_timeline_lines(tl.get("data_events", []), ws))
 
     if tl["anomalies"]:
         out.append("")
@@ -551,6 +618,9 @@ def write_plots(tl: Dict[str, Any], out_dir: str) -> List[str]:
          [None if w.get("hbm_bytes_in_use") is None
           else w["hbm_bytes_in_use"] / 1e9 for w in ws], "HBM in use",
          "telemetry_hbm_in_use.png"),
+        ("data wait (s/window)",
+         [w.get("data_wait_sec") for w in ws], "input wait",
+         "telemetry_data_wait.png"),
     ]
     for ylabel, ys, title, fname in series:
         pts = [(s, y) for s, y in zip(steps, ys) if y is not None]

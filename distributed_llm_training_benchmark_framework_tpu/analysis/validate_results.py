@@ -141,6 +141,19 @@ ANATOMY_FRAC_FIELDS = (
 )
 ANATOMY_COMPONENT_SUM_TOL = 1.02
 ROOFLINE_PCT_MAX = 110.0
+# Streaming-data-path coherence envelope (data/stream.py, streaming
+# round): rows with data_mode == "stream" must carry an internally
+# coherent input ledger — data_stall_frac in [0, 1] (the waits happen
+# inside the published step times, so the fraction is structural),
+# cursor_end - cursor_start == records_consumed == steps_run x
+# records/step (stream-position continuity: no replayed or skipped
+# records across a stitch; the per-step record count is closed-form from
+# the row's own batch geometry), and a same-geometry resume must start
+# exactly where the restored checkpoint's sidecar left off. A
+# geometry-change resume changes records/step, so only the within-run
+# arithmetic is checkable there. records_skipped is additionally
+# cross-checked against the telemetry quarantine events in
+# validate_telemetry.
 # Memory-anatomy envelope (analysis/memory_anatomy.py): rows carrying the
 # reconciliation must be internally coherent — the persisted estimate and
 # the measured column must COEXIST (hbm_measured may be null only with an
@@ -436,6 +449,99 @@ def validate_result(r: dict, name: str) -> List[str]:
         _check(skew >= 0.0, name,
                f"straggler_skew_pct={skew} is negative", f)
 
+    # Streaming-data-path coherence envelope (see the constants note).
+    if r.get("data_mode") == "stream":
+        dsf = r.get("data_stall_frac")
+        _check(
+            isinstance(dsf, (int, float)) and dsf == dsf
+            and -1e-9 <= dsf <= 1.0 + 1e-9, name,
+            f"data_stall_frac={dsf} missing or outside [0, 1] on a "
+            "stream row — the starvation accounting broke", f,
+        )
+        skipped = r.get("records_skipped")
+        _check(
+            isinstance(skipped, int) and skipped >= 0, name,
+            f"records_skipped={skipped} must be a non-negative count", f,
+        )
+        consumed = int(r.get("records_consumed") or 0)
+        cs = int(r.get("stream_cursor_start", -1))
+        ce = int(r.get("stream_cursor_end", -1))
+        _check(
+            cs >= 0 and ce >= cs, name,
+            f"stream cursors [{cs}, {ce}] incoherent on a stream row", f,
+        )
+        if cs >= 0 and ce >= cs:
+            _check(
+                ce - cs == consumed, name,
+                f"stream_cursor_end - stream_cursor_start = {ce - cs} but "
+                f"records_consumed={consumed} — the stream ledger is "
+                "incoherent", f,
+            )
+            denom = max(
+                int(r.get("tensor_parallel") or 1)
+                * int(r.get("sequence_parallel") or 1)
+                * int(r.get("pipeline_parallel") or 1)
+                * int(r.get("expert_parallel") or 1), 1,
+            )
+            dp = max(int(r["world_size"]) // denom, 1)
+            rps = (
+                int(r["per_device_batch"]) * int(r["grad_accum"]) * dp
+                * int(r.get("expert_parallel") or 1)
+            )
+            # NOT `or -1`: resume_step=0 is a legitimate restore (a run
+            # stalled/preempted at step 1 checkpoints step 0) and must
+            # not collapse to the falsy default.
+            rs = r.get("resume_step")
+            start = (int(rs) + 1
+                     if r.get("resumed") and rs is not None else 0)
+            expected = (int(r.get("steps") or 0) - start) * rps
+            _check(
+                consumed == expected, name,
+                f"records_consumed={consumed} != (steps-{start}) x "
+                f"{rps} records/step = {expected} — records were "
+                "replayed or skipped across the run", f,
+            )
+            if (
+                r.get("resumed")
+                and not r.get("resume_geometry_changed")
+                and int(r.get("n_restarts") or 0) == 1
+            ):
+                # Cross-run cursor continuity is closed-form only when
+                # the WHOLE checkpoint lineage ran this geometry: on the
+                # first resume, a same-geometry stitch means the prior
+                # run was a cold start with this records/step. A later
+                # restart (n_restarts > 1) may sit downstream of an
+                # earlier geometry-change resume whose era consumed a
+                # different records/step — there the sidecar cursor is
+                # authoritative and only the within-run arithmetic above
+                # is checkable.
+                _check(
+                    cs == start * rps, name,
+                    f"stream_cursor_start={cs} but a same-geometry "
+                    f"first resume from step {start - 1} must start at "
+                    f"{start * rps} — the stitch replayed or skipped "
+                    "records", f,
+                )
+            elif not r.get("resumed"):
+                _check(
+                    cs == 0, name,
+                    f"stream_cursor_start={cs} on a non-resumed stream "
+                    "row (must be 0)", f,
+                )
+    else:
+        # Synthetic rows must stay inert: a stall fraction or skip count
+        # on the zero-IO table means the accounting leaked across paths.
+        if r.get("data_stall_frac") is not None:
+            f.append(
+                f"{name}: data_stall_frac={r['data_stall_frac']} on a "
+                "non-stream row — the input accounting leaked"
+            )
+        if int(r.get("records_skipped") or 0) > 0:
+            f.append(
+                f"{name}: records_skipped={r['records_skipped']} on a "
+                "non-stream row — the quarantine accounting leaked"
+            )
+
     # Memory-anatomy envelope (HBM_BOOKS_CLOSE_TOL_GIB above).
     attr = r.get("hbm_attribution")
     if isinstance(attr, dict):
@@ -531,6 +637,21 @@ def validate_telemetry(result_path: str, r: dict, name: str) -> List[str]:
             unresolved == 0, name,
             f"telemetry shows {unresolved} unresolved anomaly event(s) "
             "(NaN loss / open step-time spike) — row rejected", f,
+        )
+    if r.get("data_mode") == "stream":
+        # The quarantine ledger must match the telemetry trail exactly:
+        # one data_corrupt_record event per healed record. A mismatch in
+        # either direction means the skip accounting (or the event drain)
+        # broke — the "honest records_skipped ledger" contract.
+        n_events = sum(
+            1 for e in events if e.get("event") == "data_corrupt_record"
+        )
+        row_skipped = int(r.get("records_skipped") or 0)
+        _check(
+            n_events == row_skipped, name,
+            f"records_skipped={row_skipped} but telemetry holds "
+            f"{n_events} data_corrupt_record event(s) — the quarantine "
+            "ledger and the telemetry trail disagree", f,
         )
     return f
 

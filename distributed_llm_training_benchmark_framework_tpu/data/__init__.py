@@ -1,3 +1,31 @@
+"""Input pipelines: the zero-IO synthetic table and the streaming path.
+
+- :mod:`.synthetic` — the reference-parity seeded token table (default:
+  every arm's byte-identical, input-never-bound baseline).
+- :mod:`.stream` — the fault-tolerant sharded record reader (checksummed
+  records, skip-and-quarantine, bounded retry, exact-resume cursor).
+- :mod:`.prefetch` — the bounded double-buffered host prefetcher with
+  per-host sharded device put and measured-starvation accounting
+  (``data_stall_frac``).
+"""
+
+from .prefetch import DataStallTimeout, HostPrefetcher  # noqa: F401
+from .stream import (  # noqa: F401
+    EXIT_DATA_STALL,
+    DataReadError,
+    DataStalled,
+    MissingShardError,
+    ShardedTokenStream,
+)
 from .synthetic import SyntheticDataset
 
-__all__ = ["SyntheticDataset"]
+__all__ = [
+    "DataReadError",
+    "DataStallTimeout",
+    "DataStalled",
+    "EXIT_DATA_STALL",
+    "HostPrefetcher",
+    "MissingShardError",
+    "ShardedTokenStream",
+    "SyntheticDataset",
+]

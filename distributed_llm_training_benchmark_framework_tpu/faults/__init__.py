@@ -9,9 +9,13 @@ package is the proving ground:
 - :mod:`.injection` — a registry of injectable faults (``sigkill@N``,
   ``sigterm@N``, ``nan-loss@N``, ``hang@N``, ``stall-rank@N:R``,
   ``bitflip@N``, ``grad-explode@N``, ``torn-checkpoint``,
-  ``enospc-on-save``), armed via the harness ``--inject-fault`` flag or
-  the ``INJECT_FAULT`` env var, each firing at an exact sync-window
-  boundary so a chaos run aborts at the same step every time.
+  ``enospc-on-save``, plus the streaming-data kinds
+  ``data-stall@N[:SECS]`` / ``data-corrupt-record@N`` /
+  ``data-slow-reader@N:MS`` / ``data-missing-shard@K``), armed via the
+  harness ``--inject-fault`` flag or the ``INJECT_FAULT`` env var, each
+  firing at an exact sync-window boundary (or an exact record/shard
+  index for the data kinds) so a chaos run aborts at the same point
+  every time.
 - :mod:`.preemption` — the SIGTERM-to-emergency-checkpoint guard the
   train loop polls at sync boundaries, the :class:`Preempted` control
   exception, and the distinct ``EXIT_PREEMPTED`` process exit code the
@@ -34,6 +38,7 @@ the operator contract.
 """
 
 from .injection import (  # noqa: F401
+    DATA_KINDS,
     FAULT_KINDS,
     FaultInjector,
     FaultSpec,
@@ -57,6 +62,7 @@ from .watchdog import (  # noqa: F401
 )
 
 __all__ = [
+    "DATA_KINDS",
     "FAULT_KINDS",
     "FaultInjector",
     "FaultSpec",

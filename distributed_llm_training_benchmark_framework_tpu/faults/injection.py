@@ -81,16 +81,53 @@ FAULT_KINDS = {
                        "must quarantine and fall back)",
     "enospc-on-save": "every checkpoint save raises OSError(ENOSPC); the "
                       "run must degrade and still finish",
+    "data-stall": "data-stall@N[:SECS] — the streaming input source goes "
+                  "silent before the batch for step N (default stall "
+                  "3600 s): the prefetch producer sleeps, the timed loop "
+                  "starves, and the run must classify reason=data_stall "
+                  "(exit 78, retryable-with-resume) — NOT the watchdog's "
+                  "hang. Requires --data-path",
+    "data-corrupt-record": "data-corrupt-record@N — flip one byte of "
+                           "global record N's payload as it is read "
+                           "(emulated disk bit-rot; the files are never "
+                           "mutated): the CRC check must catch it, the "
+                           "slot heals by substitution, and the "
+                           "records_skipped ledger + data_corrupt_record "
+                           "telemetry event record the quarantine. "
+                           "Requires --data-path",
+    "data-slow-reader": "data-slow-reader@N:MS — every record read from "
+                        "global record N onward takes MS extra "
+                        "milliseconds (a degraded mount): the run must "
+                        "COMPLETE with an honest, elevated "
+                        "data_stall_frac — degrade, never die. Requires "
+                        "--data-path",
+    "data-missing-shard": "data-missing-shard@K — shard K is withheld "
+                          "from discovery (a hole in the corpus): the "
+                          "stream must REFUSE loudly naming the shard "
+                          "before any device work — training on a "
+                          "silently truncated corpus is the failure this "
+                          "proves impossible. Requires --data-path",
 }
 
-#: Kinds that take a mandatory ``@N`` step.
+#: Kinds that take a mandatory ``@N`` step (for the data kinds, N is a
+#: global record index / shard index rather than an optimizer step — the
+#: same "a fault without a firing point is not reproducible" rule).
 STEPPED_KINDS = frozenset(
     {"sigkill", "sigterm", "sigterm-rank", "nan-loss", "hang",
-     "stall-rank", "bitflip", "grad-explode", "opt-moments"}
+     "stall-rank", "bitflip", "grad-explode", "opt-moments",
+     "data-stall", "data-corrupt-record", "data-slow-reader",
+     "data-missing-shard"}
 )
 
 #: Kinds whose ``@N:R`` suffix names a target rank.
 RANKED_KINDS = frozenset({"sigterm-rank", "stall-rank"})
+
+#: Data-path kinds (fire inside data/stream.py + data/prefetch.py via the
+#: injector's data_* hooks; require --data-path to have any consumer).
+DATA_KINDS = frozenset(
+    {"data-stall", "data-corrupt-record", "data-slow-reader",
+     "data-missing-shard"}
+)
 
 #: The bitflip magnitude: large enough that a squared-norm reduction in
 #: f32 overflows to inf (1e30^2 > f32 max), so the sentinel's checksum /
@@ -136,6 +173,10 @@ class FaultSpec:
     # parses the same spec (the suite passes one value to every worker);
     # the injector compares against its own rank at fire time.
     rank: Optional[int] = None
+    # data-slow-reader@N:MS — per-record extra read latency in
+    # milliseconds (its own field so the spec string round-trips in the
+    # unit the operator wrote; hang_sec stays seconds).
+    delay_ms: Optional[float] = None
 
     def __str__(self) -> str:
         s = self.kind
@@ -146,6 +187,8 @@ class FaultSpec:
             s += f":{self.rank}"
         if self.hang_sec is not None:
             s += f":{self.hang_sec:g}"
+        if self.delay_ms is not None:
+            s += f":{self.delay_ms:g}"
         return s
 
 
@@ -175,16 +218,25 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
                 "(a fault without a firing step is not reproducible)"
             )
         step_str, _, suffix = rest.partition(":")
-        if suffix and kind not in ("hang", *RANKED_KINDS):
+        if suffix and kind not in (
+            "hang", "data-stall", "data-slow-reader", *RANKED_KINDS
+        ):
             raise ValueError(
-                f"only 'hang' and the ranked kinds "
-                f"({sorted(RANKED_KINDS)}) take a suffix, got {spec!r}"
+                f"only 'hang', 'data-stall', 'data-slow-reader' and the "
+                f"ranked kinds ({sorted(RANKED_KINDS)}) take a suffix, "
+                f"got {spec!r}"
             )
         if kind in RANKED_KINDS and not suffix:
             raise ValueError(
                 f"{kind} needs a target rank: {kind}@N:R (without one the "
                 f"fault is rankless — which rank it hits is the whole "
                 "point of the spec)"
+            )
+        if kind == "data-slow-reader" and not suffix:
+            raise ValueError(
+                f"data-slow-reader needs a per-record latency: "
+                f"data-slow-reader@N:MS (without one the degradation it "
+                f"injects is undefined), got {spec!r}"
             )
         try:
             step = int(step_str)
@@ -194,6 +246,20 @@ def parse_fault_spec(spec: Optional[str]) -> Optional[FaultSpec]:
             raise ValueError(f"fault step must be >= 0, got {spec!r}")
         hang_sec = None
         rank = None
+        delay_ms = None
+        if suffix and kind == "data-slow-reader":
+            try:
+                delay_ms = float(suffix)
+            except ValueError:
+                raise ValueError(
+                    f"data-slow-reader latency must be a number of "
+                    f"milliseconds, got {spec!r}"
+                )
+            if delay_ms <= 0:
+                raise ValueError(
+                    f"data-slow-reader latency must be > 0, got {spec!r}"
+                )
+            return FaultSpec(kind=kind, step=step, delay_ms=delay_ms)
         if suffix and kind in RANKED_KINDS:
             rank_str, _, secs_str = suffix.partition(":")
             if secs_str and kind != "stall-rank":
@@ -524,3 +590,73 @@ class FaultInjector:
             f"tore checkpoint step {max(steps)} ({torn}); SIGKILL"
         )
         os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- data-path faults (consumed by data/stream.py + data/prefetch.py) --
+
+    def data_missing_shard(self) -> Optional[int]:
+        """Shard index to withhold from discovery (``data-missing-shard@K``),
+        or None. Fires at stream construction — pre-dispatch, so the
+        refusal it provokes never wastes device time."""
+        if self.spec is None or self.spec.kind != "data-missing-shard":
+            return None
+        if not self.fired:
+            self.fired = True
+            self._announce(
+                f"shard {self.spec.step} withheld from discovery — the "
+                "stream must refuse loudly naming it"
+            )
+        return self.spec.step
+
+    def data_stall_sec(self, step: int) -> float:
+        """Seconds the prefetch producer sleeps before the batch for step
+        N (``data-stall@N[:SECS]``); 0.0 otherwise. Runs on the prefetch
+        thread — the announce reaches the JSONL before the consumer
+        starves, so the trail records what stalled it."""
+        if (
+            self.spec is None or self.fired
+            or self.spec.kind != "data-stall" or step != self.spec.step
+        ):
+            return 0.0
+        self.fired = True
+        secs = self.spec.hang_sec or HANG_DEFAULT_SEC
+        self._announce(
+            f"input source silent for {secs:g}s before the batch for "
+            f"step {step}"
+        )
+        return secs
+
+    def data_corrupt_payload(self, global_index: int, payload: bytes) -> bytes:
+        """Flip one byte of global record N's payload as read
+        (``data-corrupt-record@N``; passthrough otherwise). Emulates disk
+        bit-rot deterministically WITHOUT mutating the shard files — the
+        CRC check downstream must catch it."""
+        if (
+            self.spec is None or self.fired
+            or self.spec.kind != "data-corrupt-record"
+            or global_index != self.spec.step
+        ):
+            return payload
+        self.fired = True
+        self._announce(
+            f"flipped one payload byte of global record {global_index} "
+            "(CRC must catch it; slot heals by substitution)"
+        )
+        return bytes([payload[0] ^ 0xFF]) + payload[1:]
+
+    def data_read_delay_sec(self, global_index: int) -> float:
+        """Extra per-record read latency from record N on
+        (``data-slow-reader@N:MS``); 0.0 otherwise. ``fired`` only gates
+        the announce — the degradation persists for the rest of the run,
+        which is what makes data_stall_frac measurable."""
+        if (
+            self.spec is None or self.spec.kind != "data-slow-reader"
+            or global_index < (self.spec.step or 0)
+        ):
+            return 0.0
+        if not self.fired:
+            self.fired = True
+            self._announce(
+                f"every record read from global record {self.spec.step} "
+                f"on takes +{self.spec.delay_ms:g} ms (degraded mount)"
+            )
+        return (self.spec.delay_ms or 0.0) / 1000.0

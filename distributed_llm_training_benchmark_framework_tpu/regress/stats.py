@@ -110,6 +110,15 @@ SECONDARY_METRICS = (
     # model's documented accuracy band is ±20% — the gate polices
     # DECAY, not the residual itself.
     ("hbm_model_drift_frac", False, 5.0, "abs_pp"),
+    # Streaming-data-path input starvation (train/loop.py + data/
+    # prefetch.py): the fraction of timed step wall the loop spent
+    # starved for input. Only streaming (--data-path) rows carry it
+    # (synthetic rows publish null, so the both-rows-present rule skips
+    # them). Absolute pp scale like the other fractions — a healthy
+    # stream legitimately sits at ~0, where a relative delta is
+    # undefined; 2 pp of new input-boundedness is a regression even when
+    # the wall-clock delta hides inside the throughput noise floor.
+    ("data_stall_frac", False, 2.0, "abs_pp"),
 )
 #: Absolute-scale fallback noise floor (percentage points) below 3
 #: same-config history runs.

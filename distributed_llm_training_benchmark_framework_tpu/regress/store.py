@@ -222,6 +222,12 @@ def config_key(record: Dict[str, Any]) -> Tuple:
         # Remat policy trades HBM for recompute: every --remat-sweep
         # point is its own lineage (absent on legacy rows -> None).
         r.get("remat_policy"),
+        # Input path is methodology: a streaming (--data-path) run pays
+        # host-read + device-put costs the synthetic table never does, so
+        # it must not gate against (or feed the noise floor of) the
+        # synthetic lineage. Legacy rows carry no field -> normalized to
+        # "synthetic" so existing history stays one lineage.
+        r.get("data_mode") or "synthetic",
     )
 
 

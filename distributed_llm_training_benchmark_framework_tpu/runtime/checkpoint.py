@@ -49,6 +49,14 @@ Elastic-resilience round (geometry-change resume + async delta saves):
   ``resume_geometry_changed=true`` (telemetry, result row, restart
   ledger; the regress registry keeps such rows out of the baseline set
   exactly like plain resumed rows).
+- **Stream sidecars** (streaming-data round): runs on the streaming input
+  path (``--data-path``) persist the stream's exact-resume iterator state
+  (``data/stream.py`` ``state_dict`` — delivered-records cursor +
+  skip ledger total) as ``stream_<step>.json`` beside each committed
+  step, so a resume consumes precisely the un-consumed records — the
+  cursor is geometry-independent, so the sidecar survives a
+  geometry-change resume unchanged while per-host shard ownership is
+  recomputed from the new batch sharding.
 - **Async delta checkpointing** (``async_save=True``): periodic saves
   dispatch orbax's async writer and return without blocking the timed
   path; the digest/geometry sidecars are written when the commit
@@ -262,6 +270,9 @@ class BenchmarkCheckpointer:
     def _geometry_path(self, step: int) -> str:
         return os.path.join(self.directory, f"geometry_{step}.json")
 
+    def _stream_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"stream_{step}.json")
+
     @property
     def quarantine_dir(self) -> str:
         return os.path.join(self.directory, QUARANTINE_DIRNAME)
@@ -380,6 +391,40 @@ class BenchmarkCheckpointer:
             return None
         return raw
 
+    def _write_stream_state(self, step: int,
+                            state: Optional[Dict[str, Any]]) -> None:
+        """Persist the data stream's exact-resume iterator state beside
+        the step (``stream_<step>.json`` — data/stream.py state_dict).
+        Same degrade posture as the geometry sidecar: a failed write
+        warns and the step resumes with the closed-form cursor fallback,
+        never a failed benchmark."""
+        if state is None:
+            return
+        try:
+            _atomic_write_json(self._stream_path(step), dict(state))
+        except OSError as e:
+            print(f"WARNING: stream-state sidecar for step {step} not "
+                  f"written ({e}); resume will use the closed-form cursor")
+
+    def read_stream_state(self, step: int) -> Optional[Dict[str, Any]]:
+        """The step's stream-state sidecar, or None (synthetic-path
+        checkpoint, unreadable sidecar, or a newer schema we cannot
+        judge — same posture as the geometry sidecar)."""
+        from ..data.stream import STREAM_STATE_SCHEMA_VERSION
+
+        path = self._stream_path(step)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (ValueError, OSError):
+            return None
+        ver = raw.get("schema_version")
+        if not isinstance(ver, int) or ver > STREAM_STATE_SCHEMA_VERSION:
+            return None
+        return raw
+
     def step_meta(self, step: int) -> Dict[str, Any]:
         """The ``meta`` dict stored with the step's digest ({} if none).
 
@@ -448,6 +493,14 @@ class BenchmarkCheckpointer:
             shutil.move(
                 self._geometry_path(step),
                 os.path.join(dest, os.path.basename(self._geometry_path(step))),
+            )
+        if os.path.exists(self._stream_path(step)):
+            # The stream sidecar travels too: a quarantined step must not
+            # leave its iterator state behind for a DIFFERENT step's
+            # resume to misread as its own position.
+            shutil.move(
+                self._stream_path(step),
+                os.path.join(dest, os.path.basename(self._stream_path(step))),
             )
         _atomic_write_json(os.path.join(dest, "QUARANTINE.json"), {
             "schema_version": DIGEST_SCHEMA_VERSION,
@@ -574,6 +627,7 @@ class BenchmarkCheckpointer:
         opt_state: Any,
         force: bool = False,
         meta: Optional[Dict[str, Any]] = None,
+        stream_state: Optional[Dict[str, Any]] = None,
     ) -> bool:
         # Check the directory's layout BEFORE persisting anything: a save
         # into a directory holding checkpoints of a DIFFERENT layout must
@@ -663,6 +717,10 @@ class BenchmarkCheckpointer:
             # barrier — it certifies payload bytes. An orphan sidecar
             # from a never-committed step is reaped by _gc_digests.
             self._write_geometry(geom)
+            # The stream sidecar is host metadata like the geometry one:
+            # written at dispatch so a die-before-finalize still leaves
+            # the committed payload paired with its iterator position.
+            self._write_stream_state(step, stream_state)
             self._pending_async = (step, dict(meta or {}), geom)
             return True
         if saved:
@@ -677,6 +735,7 @@ class BenchmarkCheckpointer:
                 print(f"WARNING: checkpoint digest for step {step} not "
                       f"written ({e}); step will restore as legacy-valid")
             self._write_geometry(geom)
+            self._write_stream_state(step, stream_state)
             self._gc_digests()
         return bool(saved)
 
@@ -685,7 +744,8 @@ class BenchmarkCheckpointer:
         live = set(self.all_steps())
         for path in list(os.listdir(self.directory)):
             prefix = next(
-                (p for p in ("digest_", "geometry_") if path.startswith(p)),
+                (p for p in ("digest_", "geometry_", "stream_")
+                 if path.startswith(p)),
                 None,
             )
             if prefix is None or not path.endswith(".json"):

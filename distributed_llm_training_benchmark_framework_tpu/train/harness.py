@@ -92,7 +92,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "causal, head_dim-128 tiers — models.llama)")
     p.add_argument("--seq-len", type=int, required=True)
     p.add_argument("--synthetic", action="store_true", default=True,
-                   help="Use synthetic data (always true; flag kept live+honest)")
+                   help="Use synthetic data (the default zero-IO table; "
+                        "--data-path overrides it with the streaming path)")
+    p.add_argument("--data-path", type=str, default=None,
+                   help="Directory of tokenized record shards "
+                        "(scripts/make_tokenized_shards.py format): the "
+                        "fault-tolerant streaming input path — checksummed "
+                        "records, skip-and-quarantine healing, bounded "
+                        "read retries, exact-resume cursor sidecars, and "
+                        "a published data_stall_frac. Default: the "
+                        "synthetic table (zero input IO)")
+    p.add_argument("--data-stall-timeout-sec", type=float, default=60.0,
+                   help="With --data-path: abort as reason=data_stall "
+                        "(exit 78, retryable with --resume) when the "
+                        "timed loop starves for input this long — "
+                        "distinct from the watchdog's hang. Size it "
+                        "BELOW --hang-timeout-sec so an input outage "
+                        "classifies as data, not device")
     p.add_argument("--dataset-size", type=int, default=1000)
     p.add_argument("--attention", type=str, default="reference",
                    choices=["reference", "flash", "ring", "ulysses"],
@@ -216,8 +232,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Arm one deterministic chaos fault: sigkill@N, "
                         "sigterm@N, nan-loss@N, hang@N[:SECS], "
                         "stall-rank@N:R[:SECS], bitflip@N, "
-                        "grad-explode@N, torn-checkpoint, enospc-on-save "
-                        "— each fires at an exact sync-window boundary so "
+                        "grad-explode@N, torn-checkpoint, enospc-on-save, "
+                        "or (with --data-path) data-stall@N[:SECS], "
+                        "data-corrupt-record@N, data-slow-reader@N:MS, "
+                        "data-missing-shard@K — each fires at an exact "
+                        "sync-window boundary (or record/shard index) so "
                         "chaos runs are reproducible "
                         "(scripts/chaos_suite.sh drives the matrix)")
     # Self-healing loop (faults/watchdog.py + faults/sentinel.py,
@@ -351,6 +370,7 @@ def main(argv=None) -> int:
         num_processes=args.num_processes,
         process_id=args.rank if args.num_processes else None,
     )
+    from ..data import EXIT_DATA_STALL, DataStalled
     from ..faults import (
         EXIT_HUNG,
         EXIT_NOTHING_TO_RESUME,
@@ -412,6 +432,8 @@ def main(argv=None) -> int:
             hang_timeout_sec=args.hang_timeout_sec,
             sentinel=args.sentinel == "on",
             sentinel_checksum_every=args.sentinel_checksum_every,
+            data_path=args.data_path,
+            data_stall_timeout_sec=args.data_stall_timeout_sec,
         )
     except Preempted as e:
         # Distinct exit code: the retrying orchestration (with_retries.sh,
@@ -425,6 +447,14 @@ def main(argv=None) -> int:
         print(f"NOTHING TO RESUME: {e} — exiting {EXIT_NOTHING_TO_RESUME}",
               flush=True)
         return EXIT_NOTHING_TO_RESUME
+    except DataStalled as e:
+        # The input path starved the timed loop: its own retryable code —
+        # the device was healthy, so retry wrappers resume exactly like a
+        # preemption (the stream sidecar carries the cursor), while the
+        # classification separates an input outage from a device hang.
+        print(f"DATA STALL: {e} — exiting {EXIT_DATA_STALL} "
+              "(resume with --resume)", flush=True)
+        return EXIT_DATA_STALL
     except Hung as e:
         # A PEER rank's watchdog reported a hang (this rank is healthy —
         # the stuck one already dumped its stacks and exited 76 from its

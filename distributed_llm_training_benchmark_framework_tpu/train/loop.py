@@ -31,6 +31,22 @@ reason=preempted`` plus a final heartbeat, and exits with the distinct
 boundaries host the deterministic fault injector (``--inject-fault`` /
 ``INJECT_FAULT``) the chaos suite uses to prove all of this works.
 
+Streaming data path (docs/FAULT_TOLERANCE.md, ROADMAP direction 5):
+``--data-path`` swaps the device-resident synthetic table for the
+fault-tolerant sharded record stream (``data/stream.py``) behind a
+bounded double-buffered host prefetcher (``data/prefetch.py``) — the
+default synthetic path is untouched. The prefetcher's ``get()`` is the
+ONE sanctioned blocking pull on the input path inside the timed loop
+(graftcheck GC111); its measured waits accumulate into the published
+``data_stall_frac`` (a gated secondary metric), a window that starved
+past half its wall emits a ``data_stall`` telemetry event, and a wait
+past ``--data-stall-timeout-sec`` aborts the run as ``reason=data_stall``
+(exit ``EXIT_DATA_STALL`` 78, retryable-with-resume) — distinct from the
+watchdog's ``hang``: the device was healthy, the INPUT path starved it.
+Every checkpoint save carries the stream's exact-resume cursor in a
+``stream_<step>.json`` sidecar, so a killed run resumes consuming
+precisely the un-consumed records, including across geometry changes.
+
 Self-healing round (docs/FAULT_TOLERANCE.md): two more boundary-cadence
 guards ride the same discipline. The **hang watchdog**
 (``faults.HangWatchdog``, ``--hang-timeout-sec``) is beaten at every
@@ -58,8 +74,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..data import SyntheticDataset
+from ..data import DataStalled, DataStallTimeout, SyntheticDataset
+from ..data.stream import STREAM_STATE_SCHEMA_VERSION
 from ..faults import (
+    DATA_KINDS,
     FaultInjector,
     HangWatchdog,
     NothingToResume,
@@ -145,6 +163,50 @@ def _make_recorder(kwargs: dict) -> TelemetryRecorder:
             seq_len, dp, int(kwargs.get("expert_parallel", 1)),
         )
         rank = int(kwargs.get("rank", 0))
+        meta = {
+            "strategy": strategy.name,
+            "world_size": world_size,
+            "rank": rank,
+            "seq_len": seq_len,
+            "tier": tier,
+            "model_family": family,
+            "per_device_batch": int(kwargs["per_device_batch"]),
+            "grad_accum": int(kwargs["grad_accum"]),
+            # Composition axes: arms sharing (strategy, ws, seq, tier)
+            # geometry — the zigzag A/B pair, tp vs pp arms — must stay
+            # distinguishable in a salvaged partial row, or the
+            # metrics-dedup collapses two dead arms into one.
+            "attention_impl": kwargs.get("attention_impl", "reference"),
+            "tensor_parallel": int(kwargs.get("tensor_parallel", 1)),
+            "sequence_parallel": int(kwargs.get("sequence_parallel", 1)),
+            "pipeline_parallel": int(kwargs.get("pipeline_parallel", 1)),
+            "pipeline_schedule": kwargs.get("pipeline_schedule", "gpipe"),
+            # The step-anatomy bubble cross-check needs V to derive the
+            # interleaved schedule's structural bound from the trace;
+            # effective value (only interleaved runs virtual chunks).
+            # The omitted-kwarg default MUST match _run_benchmark_impl's
+            # signature default (2) or the recorded V lies about the
+            # compiled schedule and the bound goes silently loose.
+            "virtual_stages": (
+                int(kwargs.get("virtual_stages", 2))
+                if int(kwargs.get("pipeline_parallel", 1)) > 1
+                and kwargs.get("pipeline_schedule") == "interleaved"
+                else 1
+            ),
+            "expert_parallel": int(kwargs.get("expert_parallel", 1)),
+            "n_experts": int(kwargs.get("n_experts", 0)),
+            "causal": bool(kwargs.get("causal", False)),
+            "ring_zigzag": {None: "auto", True: "on", False: "off"}[
+                kwargs.get("ring_zigzag")
+            ],
+        }
+        if kwargs.get("data_path"):
+            # Stream identity in every heartbeat: a salvaged partial row
+            # must land in the STREAM regress lineage (store.config_key
+            # reads data_mode off the row), never the synthetic one.
+            # Synthetic runs omit the key so their heartbeat/telemetry
+            # bytes stay unchanged.
+            meta["data_mode"] = "stream"
         rec = TelemetryRecorder(
             arm,
             results_dir=kwargs.get("results_dir"),
@@ -154,43 +216,7 @@ def _make_recorder(kwargs: dict) -> TelemetryRecorder:
             tokens_per_step=step_tokens,
             total_steps=int(kwargs["steps"]),
             rank=rank,
-            meta={
-                "strategy": strategy.name,
-                "world_size": world_size,
-                "rank": rank,
-                "seq_len": seq_len,
-                "tier": tier,
-                "model_family": family,
-                "per_device_batch": int(kwargs["per_device_batch"]),
-                "grad_accum": int(kwargs["grad_accum"]),
-                # Composition axes: arms sharing (strategy, ws, seq, tier)
-                # geometry — the zigzag A/B pair, tp vs pp arms — must stay
-                # distinguishable in a salvaged partial row, or the
-                # metrics-dedup collapses two dead arms into one.
-                "attention_impl": kwargs.get("attention_impl", "reference"),
-                "tensor_parallel": int(kwargs.get("tensor_parallel", 1)),
-                "sequence_parallel": int(kwargs.get("sequence_parallel", 1)),
-                "pipeline_parallel": int(kwargs.get("pipeline_parallel", 1)),
-                "pipeline_schedule": kwargs.get("pipeline_schedule", "gpipe"),
-                # The step-anatomy bubble cross-check needs V to derive the
-                # interleaved schedule's structural bound from the trace;
-                # effective value (only interleaved runs virtual chunks).
-                # The omitted-kwarg default MUST match _run_benchmark_impl's
-                # signature default (2) or the recorded V lies about the
-                # compiled schedule and the bound goes silently loose.
-                "virtual_stages": (
-                    int(kwargs.get("virtual_stages", 2))
-                    if int(kwargs.get("pipeline_parallel", 1)) > 1
-                    and kwargs.get("pipeline_schedule") == "interleaved"
-                    else 1
-                ),
-                "expert_parallel": int(kwargs.get("expert_parallel", 1)),
-                "n_experts": int(kwargs.get("n_experts", 0)),
-                "causal": bool(kwargs.get("causal", False)),
-                "ring_zigzag": {None: "auto", True: "on", False: "off"}[
-                    kwargs.get("ring_zigzag")
-                ],
-            },
+            meta=meta,
         )
         rec.begin_phase("init")
         return rec
@@ -313,6 +339,8 @@ def _run_benchmark_impl(
     hang_timeout_sec: float = 0.0,
     sentinel: bool = False,
     sentinel_checksum_every: int = 0,
+    data_path: Optional[str] = None,
+    data_stall_timeout_sec: float = 60.0,
     recorder: Optional[TelemetryRecorder] = None,
     preempt_guard: Optional[PreemptionGuard] = None,
     hang_watchdog: Optional[HangWatchdog] = None,
@@ -329,7 +357,11 @@ def _run_benchmark_impl(
     ``hang_timeout_sec`` arms the hang watchdog (``hang_watchdog`` is the
     wrapper-owned instance so its disarm outlives this frame); ``sentinel``
     arms the numerics sentinel with ``sentinel_checksum_every`` as the
-    parameter-checksum cadence (0 = checksum guard off).
+    parameter-checksum cadence (0 = checksum guard off). ``data_path``
+    selects the streaming input path (a directory of tokenized record
+    shards — see the module docstring) and ``data_stall_timeout_sec`` is
+    the starvation bound past which the run aborts as
+    ``reason=data_stall``.
     """
     if recorder is None:
         # Direct-impl callers (tests) still get phase accounting.
@@ -342,6 +374,28 @@ def _run_benchmark_impl(
     watchdog = hang_watchdog or HangWatchdog(
         hang_timeout_sec, recorder=recorder, is_main=is_main, rank=rank,
     )
+    use_stream = data_path is not None
+    if use_stream and sentinel:
+        # The sentinel's heal replays steps, which on the synthetic table
+        # works by reseeding the step-index fold; a record stream would
+        # need an in-run rewind of the prefetch pipeline to replay, which
+        # no arm needs yet. Refuse loudly rather than silently running a
+        # sentinel whose rollback would corrupt the stream position.
+        raise ValueError(
+            "--sentinel on is not supported with --data-path yet: the "
+            "rollback-and-replay heal cannot rewind the record stream "
+            "mid-run; drop one of the two flags"
+        )
+    if use_stream and data_stall_timeout_sec <= 0:
+        # A non-positive timeout would classify every normal batch wait
+        # as a fatal stall (or disable the classification entirely,
+        # depending on sign) while the result row still recorded the
+        # streaming identity — the silent-misconfiguration class the
+        # other refusals exist for.
+        raise ValueError(
+            f"--data-stall-timeout-sec must be > 0, got "
+            f"{data_stall_timeout_sec}"
+        )
     numerics = (
         NumericsSentinel(recorder=recorder, is_main=is_main)
         if sentinel else None
@@ -362,6 +416,15 @@ def _run_benchmark_impl(
         ),
         recorder=recorder, is_main=is_main, rank=rank,
     )
+    if chaos.spec is not None and chaos.spec.kind in DATA_KINDS and not use_stream:
+        # A data fault without the stream has no consumer: the run would
+        # train normally and exit 0 while the chaos report claimed the
+        # fault was survived — a silently inert injection proves nothing.
+        raise ValueError(
+            f"--inject-fault {chaos.spec} is a streaming-data fault and "
+            "requires --data-path (without the stream the injector's "
+            "data hooks have no consumer and the chaos run is inert)"
+        )
     devices = jax.devices()
     # Multihost dryrun shape: a jax.distributed rendezvous exists (the
     # cross-host preempt-soon broadcast rides it) but each host drives its
@@ -607,7 +670,10 @@ def _run_benchmark_impl(
     # startup skips one full init compile.
     state = create_train_state(
         model_config, strategy, mesh, seed=seed, grad_accum=grad_accum,
-        from_table=True, global_micro=global_micro, seq_len=seq_len,
+        # Streaming runs feed per-step batches from the host prefetcher;
+        # the synthetic path keeps the in-jit table gather (zero per-step
+        # host->device transfers), byte-identical to every prior round.
+        from_table=not use_stream, global_micro=global_micro, seq_len=seq_len,
         pipeline_schedule=pipeline_schedule, virtual_stages=virtual_stages,
         abstract_init=dpu_serial_phase, sentinel=sentinel_in_step,
     )
@@ -628,7 +694,8 @@ def _run_benchmark_impl(
             model_config,
             _dc.replace(strategy, offload_delayed_update=False),
             mesh, seed=seed, grad_accum=grad_accum,
-            from_table=True, global_micro=global_micro, seq_len=seq_len,
+            from_table=not use_stream, global_micro=global_micro,
+            seq_len=seq_len,
             pipeline_schedule=pipeline_schedule,
             virtual_stages=virtual_stages, sentinel=sentinel_in_step,
         )
@@ -636,24 +703,53 @@ def _run_benchmark_impl(
         print(f"Model initialized: {state.n_params/1e6:.2f}M parameters")
         print(f"Init time: {time.perf_counter() - t_init:.1f}s")
 
-    ds = SyntheticDataset(
-        vocab_size=model_config.vocab_size, seq_len=seq_len, size=dataset_size, seed=seed
-    )
-    if is_main:
-        print(f"SyntheticDataset: {dataset_size} samples, seq_len={seq_len}")
-
-    # The dataset table lives on-device for the whole run (8 MB at reference
-    # scale): per-step batches are gathered inside the jitted step from the
-    # step index, so the hot loop performs zero host->device transfers.
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    replicated = NamedSharding(mesh, P())
-    if jax.process_count() > 1:
-        table = jax.make_array_from_callback(
-            ds.data.shape, replicated, lambda idx: ds.data[idx]
+    # Streaming-data-path state (None/inert on the default synthetic
+    # path): the shard stream, its prefetcher, the per-window and
+    # timed-phase starvation accumulators, and the consumed-batch resume
+    # snapshot the checkpoint sidecars persist.
+    ds = None
+    table = None
+    stream = None
+    prefetch = None
+    batch_sharding = None
+    data_meta_box: list = [None]    # resume meta of the last CONSUMED batch
+    data_wait_win = [0.0]           # input wait inside the open window
+    data_wait_timed = [0.0]         # input wait over timed (post-warmup) steps
+    records_per_step = grad_accum * global_micro
+    cursor_start = 0
+    if use_stream:
+        from ..data import HostPrefetcher, ShardedTokenStream
+        from ..parallel import strategies as strat_mod
+
+        # Stream open validates the shard set (checksummed headers,
+        # completeness) BEFORE any device work: a missing shard refuses
+        # loudly here, naming the hole, instead of wasting compile time.
+        stream = ShardedTokenStream(data_path, seq_len=seq_len, injector=chaos)
+        batch_sharding = NamedSharding(
+            mesh, P(None, *strat_mod.batch_partition_spec(mesh))
         )
+        if is_main:
+            print(f"ShardedTokenStream: {stream.describe()}")
     else:
-        table = jax.device_put(ds.data, replicated)
+        ds = SyntheticDataset(
+            vocab_size=model_config.vocab_size, seq_len=seq_len, size=dataset_size, seed=seed
+        )
+        if is_main:
+            print(f"SyntheticDataset: {dataset_size} samples, seq_len={seq_len}")
+
+        # The dataset table lives on-device for the whole run (8 MB at
+        # reference scale): per-step batches are gathered inside the jitted
+        # step from the step index, so the hot loop performs zero
+        # host->device transfers.
+        replicated = NamedSharding(mesh, P())
+        if jax.process_count() > 1:
+            table = jax.make_array_from_callback(
+                ds.data.shape, replicated, lambda idx: ds.data[idx]
+            )
+        else:
+            table = jax.device_put(ds.data, replicated)
     active_state = serial_state if serial_state is not None else state
     params, opt_state = active_state.params, active_state.opt_state
     # Timed stats keyed by step so the sentinel's rollback can truncate
@@ -752,6 +848,35 @@ def _run_benchmark_impl(
                 print("Resume requested but no valid checkpoint found — "
                       "cold start")
 
+    if use_stream:
+        # Exact-resume seek: the authoritative position is the restored
+        # step's stream sidecar (its cursor is geometry-independent, so a
+        # geometry-change resume carries it over unchanged while per-host
+        # shard ownership is recomputed from the new batch sharding). A
+        # checkpoint without one (synthetic-path directory, failed
+        # sidecar write) falls back to the closed-form cursor — exact for
+        # same-geometry resumes, where records_per_step is unchanged.
+        cursor_start = start_step * records_per_step
+        if ckpt is not None and resume_step >= 0:
+            side = ckpt.read_stream_state(resume_step)
+            if side is not None:
+                cursor_start = int(side.get("cursor", cursor_start))
+            elif is_main:
+                print("WARNING: resumed checkpoint has no stream-state "
+                      f"sidecar; using the closed-form cursor {cursor_start} "
+                      "(exact only for same-geometry resumes)")
+        stream.seek(cursor_start)
+        prefetch = HostPrefetcher(
+            stream, sharding=batch_sharding, grad_accum=grad_accum,
+            global_micro=global_micro, seq_len=seq_len,
+            start_step=start_step, stop_step=steps,
+            injector=chaos, multi_process=jax.process_count() > 1,
+        ).start()
+        if is_main:
+            print(f"Streaming data path: cursor {cursor_start}, "
+                  f"{records_per_step} records/step, stall timeout "
+                  f"{data_stall_timeout_sec:g}s")
+
     # Sentinel cheap-rollback target (self-healing follow-up (b)): a run
     # with no checkpoint cadence used to REFUSE to heal — correct for
     # benchmarks (which always checkpoint) but it made every short smoke
@@ -830,7 +955,31 @@ def _run_benchmark_impl(
         recorder.step_window(
             last_step=last, losses=window_losses,
             window_mean_step_time_sec=dt,
+            data_wait_sec=(
+                round(data_wait_win[0], 6) if prefetch is not None else None
+            ),
+            records_skipped=(
+                (data_meta_box[0] or {}).get("records_skipped")
+                if prefetch is not None else None
+            ),
         )
+        if prefetch is not None:
+            # Streaming-data boundary work, at the sanctioned GC105
+            # cadence: the quarantine ledger drains into one
+            # data_corrupt_record event per healed record, and a window
+            # that spent more than half its wall starved for input opens
+            # a (non-fatal) data_stall event — the telemetry sibling of
+            # the published data_stall_frac.
+            for entry in stream.drain_quarantine():
+                recorder.note("data_corrupt_record", step=last, **entry)
+            window_wall = dt * len(window_losses)
+            if data_wait_win[0] > max(0.5 * window_wall, 0.05):
+                recorder.note(
+                    "data_stall", step=last, fatal=False,
+                    wait_sec=round(data_wait_win[0], 6),
+                    window_sec=round(window_wall, 6),
+                )
+            data_wait_win[0] = 0.0
         last_loss_box[0] = window_losses[-1]
         pending.clear()
         watchdog.beat(last)
@@ -931,6 +1080,74 @@ def _run_benchmark_impl(
         cursor.rollback(rb_step, tripped_at)
         return rb_params, rb_opt
 
+    def _stream_state_for(at_step):
+        """The exact-resume sidecar payload for a fenced boundary at
+        ``at_step`` (None on the synthetic path). The cursor is the
+        records DELIVERED to training through that step — closed form
+        from the run's own consumption, never the prefetcher's
+        read-ahead position (which may sit a buffer depth ahead)."""
+        if stream is None:
+            return None
+        delivered = (
+            cursor_start + max(at_step + 1 - start_step, 0) * records_per_step
+        )
+        return {
+            "schema_version": STREAM_STATE_SCHEMA_VERSION,
+            "cursor": delivered,
+            "records_skipped": (data_meta_box[0] or {}).get(
+                "records_skipped", stream.records_skipped
+            ),
+            "total_records": stream.total_records,
+        }
+
+    def _data_stall_stop(at_step, waited_sec):
+        """The input path starved the loop past --data-stall-timeout-sec.
+
+        Called at a fenced boundary (the caller synced first): the device
+        state is healthy and coherent — it is the INPUT that died — so
+        this checkpoints at ``at_step`` with the stream sidecar, emits
+        the fatal ``data_stall`` event + a final ``reason=data_stall``
+        heartbeat (the partial-row classification, beside
+        preempted|crash|hang), records ``run_aborted reason=data_stall``
+        and raises :class:`DataStalled` — the harness maps it to
+        ``EXIT_DATA_STALL`` (78, retryable-with-resume: the sidecar makes
+        the retry consume exactly the un-consumed records).
+        """
+        saved = None
+        if ckpt is not None and at_step >= max(start_step, 0):
+            if ckpt.latest_step() == at_step:
+                saved = at_step
+            else:
+                recorder.begin_phase("checkpoint")
+                try:
+                    ckpt.save(at_step, params, opt_state, force=True,
+                              meta={"last_loss": last_loss_box[0],
+                                    "emergency": True,
+                                    "reason": "data_stall"},
+                              stream_state=_stream_state_for(at_step))
+                    saved = at_step
+                    if is_main:
+                        print(f"Emergency checkpoint saved at step "
+                              f"{at_step} (data stall)")
+                except Exception as e:
+                    recorder.note("checkpoint_failed", step=at_step,
+                                  error=str(e), emergency=True)
+                    if is_main:
+                        print(f"WARNING: emergency checkpoint at step "
+                              f"{at_step} failed ({e}); aborting as a "
+                              "plain data-stall partial")
+        recorder.note(
+            "data_stall", step=at_step + 1, fatal=True,
+            wait_sec=round(waited_sec, 3),
+            timeout_sec=data_stall_timeout_sec,
+        )
+        recorder.emergency_heartbeat(
+            reason="data_stall",
+            extra={"emergency_checkpoint_step": saved},
+        )
+        recorder.abort("data_stall")
+        raise DataStalled(at_step + 1, waited_sec, saved_step=saved)
+
     def _emergency_stop(at_step):
         """SIGTERM landed: checkpoint at this fenced boundary and stop.
 
@@ -990,6 +1207,7 @@ def _run_benchmark_impl(
                         at_step, params, opt_state, force=True,
                         meta={"last_loss": last_loss_box[0],
                               "emergency": True, "reason": "preempted"},
+                        stream_state=_stream_state_for(at_step),
                     )
                     saved = at_step
                     if is_main:
@@ -1091,7 +1309,28 @@ def _run_benchmark_impl(
         # explodes -> step N+1's grad-norm guard must trip FIRST).
         params = chaos.corrupt_params(step, params)
         opt_state = chaos.corrupt_opt_state(step, opt_state)
-        if numerics is None:
+        if prefetch is not None:
+            # The prefetch fence (graftcheck GC111): the one sanctioned
+            # blocking pull on the input path inside the timed loop. The
+            # measured wait feeds data_stall_frac; starving past the
+            # timeout classifies the run as reason=data_stall at the
+            # fenced boundary below — never as the watchdog's hang.
+            try:
+                stream_batch, data_meta, waited = prefetch.get(
+                    step, timeout=data_stall_timeout_sec
+                )
+            except DataStallTimeout as e:
+                sync_window(t_window)
+                _data_stall_stop(step - 1, e.waited_sec)
+            data_meta_box[0] = data_meta
+            data_wait_win[0] += waited
+            if step >= warmup_steps:
+                data_wait_timed[0] += waited
+            params, opt_state, loss = active_state.step_fn(
+                params, opt_state, stream_batch, step
+            )
+            gnorm = None
+        elif numerics is None:
             params, opt_state, loss = active_state.step_fn(
                 params, opt_state, table, step
             )
@@ -1177,7 +1416,8 @@ def _run_benchmark_impl(
                 try:
                     chaos.maybe_fail_save()
                     ckpt.save(step, params, opt_state,
-                              meta={"last_loss": last_loss_box[0]})
+                              meta={"last_loss": last_loss_box[0]},
+                              stream_state=_stream_state_for(step))
                     if is_main:
                         mode = " (async dispatch)" if checkpoint_async else ""
                         print(f"Checkpoint saved at step {step}{mode}")
@@ -1277,7 +1517,8 @@ def _run_benchmark_impl(
             try:
                 chaos.maybe_fail_save()
                 ckpt.save(steps - 1, params, opt_state, force=True,
-                          meta={"last_loss": last_loss_box[0]})
+                          meta={"last_loss": last_loss_box[0]},
+                          stream_state=_stream_state_for(steps - 1))
             except OSError as e:
                 recorder.note("checkpoint_failed", step=steps - 1,
                               error=str(e))
@@ -1310,6 +1551,12 @@ def _run_benchmark_impl(
     # (scripts/liveness_probe.sh).
     watchdog.disarm()
 
+    if prefetch is not None:
+        # Every step consumed its batch; release the producer thread and
+        # the shard file handles before the finalize tail.
+        prefetch.stop()
+        stream.close()
+
     # Fetch the step executable for XLA's compile-time accounting — one
     # fetch serves all three consumers below: measure_peak_hbm rung 2
     # (when the allocator can't report a peak), the step-anatomy
@@ -1318,7 +1565,16 @@ def _run_benchmark_impl(
     # path shares the jit executable cache, <1ms.
     compiled_step = None
     try:
-        compiled_step = active_state.aot_compile(params, opt_state, table, 0)
+        # Streaming runs compile against an abstract batch aval (their
+        # step takes a per-step batch, not the table); shapes/shardings
+        # match the prefetcher's device puts, so it is the same cache-hit.
+        aot_batch = table
+        if use_stream:
+            aot_batch = jax.ShapeDtypeStruct(
+                (grad_accum, global_micro, seq_len), jnp.int32,
+                sharding=batch_sharding,
+            )
+        compiled_step = active_state.aot_compile(params, opt_state, aot_batch, 0)
     except Exception as e:  # degrade down the fallback chain, never fail a run
         if is_main:
             print(f"WARNING: step AOT compile for memory accounting failed: {e}")
@@ -1409,7 +1665,14 @@ def _run_benchmark_impl(
     # forward over those params would run layers out of order and publish a
     # silently wrong number — skip rather than mislead.
     interleaved_params = pp > 1 and pipeline_schedule == "interleaved"
-    if n_experts > 0 and not interleaved_params:
+    if n_experts > 0 and use_stream:
+        # The diagnostic's probe batch comes from the synthetic table;
+        # a streaming MoE arm skips it honestly rather than re-reading
+        # records outside the accounted cursor.
+        if is_main:
+            print("NOTE: MoE overflow diagnostic skipped on the "
+                  "streaming data path")
+    elif n_experts > 0 and not interleaved_params:
         try:
             import functools
 
@@ -1438,6 +1701,35 @@ def _run_benchmark_impl(
     # replayed steps are absent from timed_times by construction.
     step_times = [dt for _s, dt in timed_times]
     losses = [lf for _s, lf in timed_losses]
+    # Streaming-data accounting for the published row: data_stall_frac is
+    # the fraction of TIMED step wall spent starved for input (the waits
+    # happen inside the windows whose times the row publishes, so the
+    # fraction is structurally in [0, 1]); cursor start/end make the
+    # resume continuity closed-form for validate_results.
+    data_stall_frac = None
+    data_stall_sec = 0.0
+    records_consumed = 0
+    records_skipped_total = 0
+    stream_cursor_end = -1
+    if use_stream:
+        timed_total = sum(step_times)
+        data_stall_sec = data_wait_timed[0]
+        data_stall_frac = (
+            max(0.0, min(data_stall_sec / timed_total, 1.0))
+            if timed_total > 0 else 0.0
+        )
+        # MEASURED end position — the last consumed batch's cursor
+        # snapshot, not the closed form: publishing the arithmetic would
+        # make the validator's replayed-or-skipped check tautological
+        # (both sides derived from the same multiplication). A healthy
+        # run lands exactly on (steps - start_step) * records_per_step;
+        # a drifted stream (double-advance, substitution over-consume)
+        # now fails validation instead of hiding.
+        stream_cursor_end = (data_meta_box[0] or {}).get(
+            "cursor", cursor_start
+        )
+        records_consumed = stream_cursor_end - cursor_start
+        records_skipped_total = stream.records_skipped
     result = metrics_mod.compute_result(
         strategy=strategy.name,
         world_size=world_size,
@@ -1495,6 +1787,15 @@ def _run_benchmark_impl(
         n_anomalies=recorder.n_anomalies,
         step_anatomy=step_anatomy_fields,
         memory_anatomy=memory_anatomy_fields,
+        data_mode="stream" if use_stream else "synthetic",
+        data_stall_frac=(
+            round(data_stall_frac, 6) if data_stall_frac is not None else None
+        ),
+        data_stall_sec=round(data_stall_sec, 4),
+        records_consumed=records_consumed,
+        records_skipped=records_skipped_total,
+        stream_cursor_start=cursor_start if use_stream else -1,
+        stream_cursor_end=stream_cursor_end,
     )
     if results_dir is not None:
         metrics_mod.emit_result(result, results_dir, is_main=is_main)

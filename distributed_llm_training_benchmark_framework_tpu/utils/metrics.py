@@ -418,6 +418,28 @@ class BenchmarkResult:
     hbm_attribution_source: str = ""
     hbm_reference_gib: Optional[float] = None
     hbm_model_drift_frac: Optional[float] = None
+    # --- streaming-data-path accounting (data/stream.py +
+    # data/prefetch.py, docs/FAULT_TOLERANCE.md) — run identity plus the
+    # input-path honesty ledger. ``data_mode`` is 'synthetic' (the
+    # default zero-IO table; all fields below stay at their inert
+    # defaults) or 'stream' (--data-path). ``data_stall_frac`` — fraction
+    # of timed step wall spent starved for input — is a gated secondary
+    # metric (regress.stats.SECONDARY_METRICS, abs-pp, lower-better) so
+    # an input-bound regression fails `regress gate --all` by name.
+    # ``records_skipped`` counts corrupt records healed by substitution
+    # (one quarantine-ledger entry + data_corrupt_record telemetry event
+    # each; validate_results cross-checks the counts). The cursor pair
+    # makes resume stream-position continuity closed-form: cursor_end -
+    # cursor_start == records_consumed == steps_run x records/step, and a
+    # same-geometry resume must start exactly where the checkpoint's
+    # sidecar left off (no replayed or skipped records across a stitch).
+    data_mode: str = "synthetic"
+    data_stall_frac: Optional[float] = None
+    data_stall_sec: float = 0.0
+    records_consumed: int = 0
+    records_skipped: int = 0
+    stream_cursor_start: int = -1
+    stream_cursor_end: int = -1
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -479,6 +501,13 @@ def compute_result(
     n_anomalies: int = 0,
     step_anatomy: Optional[Dict[str, Any]] = None,
     memory_anatomy: Optional[Dict[str, Any]] = None,
+    data_mode: str = "synthetic",
+    data_stall_frac: Optional[float] = None,
+    data_stall_sec: float = 0.0,
+    records_consumed: int = 0,
+    records_skipped: int = 0,
+    stream_cursor_start: int = -1,
+    stream_cursor_end: int = -1,
 ) -> BenchmarkResult:
     def _scheduler_flags() -> str:
         from . import platform as platform_mod
@@ -640,6 +669,13 @@ def compute_result(
         time_in_trace_sec=round(pt.get("trace", 0.0), 4),
         n_anomalies=n_anomalies,
         xla_scheduler_flags=_scheduler_flags(),
+        data_mode=data_mode,
+        data_stall_frac=data_stall_frac,
+        data_stall_sec=data_stall_sec,
+        records_consumed=records_consumed,
+        records_skipped=records_skipped,
+        stream_cursor_start=stream_cursor_start,
+        stream_cursor_end=stream_cursor_end,
         **anatomy_fields,
         **mem_fields,
     )
@@ -702,6 +738,15 @@ def emit_result(result: BenchmarkResult, results_dir: str, is_main: bool = True)
         )
     print(f"  H2D GB/s/chip:    {result.h2d_gbps_per_gpu:.3f}")
     print(f"  Mean loss:        {result.mean_loss:.4f}")
+    if result.data_mode == "stream":
+        print(
+            f"  Data path:        stream — stall "
+            f"{100.0 * (result.data_stall_frac or 0.0):.1f}% of timed wall "
+            f"({result.data_stall_sec:.2f}s), {result.records_consumed} "
+            f"records consumed (cursor {result.stream_cursor_start} -> "
+            f"{result.stream_cursor_end}), {result.records_skipped} "
+            "skipped/quarantined"
+        )
     if result.wall_time_total_sec > 0:
         print(
             f"  Wall time:        {result.wall_time_total_sec:.1f}s"

@@ -69,6 +69,12 @@ export RING_ZIGZAG="${RING_ZIGZAG:-auto}"
 export SEED="${SEED:-}"
 export SYNC_EVERY="${SYNC_EVERY:-}"
 export DATASET_SIZE="${DATASET_SIZE:-}"
+# Streaming data path (data/stream.py, docs/FAULT_TOLERANCE.md): a
+# directory of tokenized record shards mounted into the pod; empty keeps
+# the zero-IO synthetic table. The stall timeout classifies an input
+# outage as reason=data_stall (exit 78) — size it below HANG_TIMEOUT_SEC.
+export DATA_PATH="${DATA_PATH:-}"
+export DATA_STALL_TIMEOUT_SEC="${DATA_STALL_TIMEOUT_SEC:-}"
 export DROPOUT="${DROPOUT:-}"
 export PRNG_IMPL="${PRNG_IMPL:-}"
 export SKIP_MEMORY_CHECK="${SKIP_MEMORY_CHECK:-0}"
@@ -182,6 +188,10 @@ if [ -n "${SEED}" ]; then ARGS="${ARGS} --seed ${SEED}"; fi
 if [ -n "${SYNC_EVERY}" ]; then ARGS="${ARGS} --sync-every ${SYNC_EVERY}"; fi
 if [ -n "${DATASET_SIZE}" ]; then
   ARGS="${ARGS} --dataset-size ${DATASET_SIZE}"; fi
+if [ -n "${DATA_PATH}" ]; then
+  ARGS="${ARGS} --data-path ${DATA_PATH}"; fi
+if [ -n "${DATA_STALL_TIMEOUT_SEC}" ]; then
+  ARGS="${ARGS} --data-stall-timeout-sec ${DATA_STALL_TIMEOUT_SEC}"; fi
 if [ -n "${DROPOUT}" ]; then ARGS="${ARGS} --dropout ${DROPOUT}"; fi
 if [ -n "${PRNG_IMPL}" ]; then ARGS="${ARGS} --prng-impl ${PRNG_IMPL}"; fi
 if [ -n "${FLASH_BLOCK_Q}" ]; then

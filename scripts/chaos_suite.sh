@@ -27,9 +27,17 @@
 # Faults fire at exact sync-window boundaries (faults/injection.py), so
 # the whole suite is reproducible: same spec, same abort step, every run.
 #
+#   - the streaming-data matrix (data/stream.py, --data-path arms):
+#     data-corrupt-record heals by quarantine+substitution with an honest
+#     records_skipped ledger; data-slow-reader degrades with a measured
+#     data_stall_frac; data-stall classifies reason=data_stall (exit 78,
+#     distinct from hang) and RESUMES at the exact stream cursor;
+#     data-missing-shard refuses loudly naming the shard.
+#
 #   chaos_suite.sh                 # full matrix on the tinygpt smoke config
-#   chaos_suite.sh --smoke         # 3-fault smoke (sigkill + torn-checkpoint
-#                                  #   + bitflip sentinel-rollback)
+#   chaos_suite.sh --smoke         # 4-fault smoke (sigkill + torn-checkpoint
+#                                  #   + bitflip sentinel-rollback +
+#                                  #   data-corrupt-record stream heal)
 #   chaos_suite.sh --faults "sigterm hang" --results-dir /tmp/chaos
 #   chaos_suite.sh --elastic       # + geometry-change resume proofs
 #                                  #   (save@dp4 -> resume@dp2, and
@@ -60,14 +68,14 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 REPO_ROOT="$(pwd)"
 
-FAULTS="sigkill sigterm sigterm-rank nan-loss hang stall-rank bitflip grad-explode opt-moments torn-checkpoint enospc-on-save"
+FAULTS="sigkill sigterm sigterm-rank nan-loss hang stall-rank bitflip grad-explode opt-moments torn-checkpoint enospc-on-save data-corrupt-record data-stall data-slow-reader data-missing-shard"
 ROOT=""
 KEEP=0
 ELASTIC=0
 K8S_CHAOS=0
 while [ $# -gt 0 ]; do
   case "$1" in
-    --smoke) FAULTS="sigkill torn-checkpoint bitflip"; shift ;;
+    --smoke) FAULTS="sigkill torn-checkpoint bitflip data-corrupt-record"; shift ;;
     --faults) FAULTS="$2"; shift 2 ;;
     --elastic) ELASTIC=1; shift ;;
     --k8s-chaos) K8S_CHAOS=1; shift ;;
@@ -106,6 +114,14 @@ HARNESS=(python -u benchmarking/train_harness.py
          --steps "$STEPS" --warmup-steps "$WARMUP" --per-device-batch 1
          --grad-accum 1 --dataset-size 64 --heartbeat-sec 0 --sync-every 2)
 
+# Streaming-data fixtures (data/stream.py): the data-fault arms read
+# tokenized shards, generated fresh per run (a few KB, <1 s; the
+# byte-frozen copies the unit tests pin live in tests/fixtures/shards/).
+SHARDS="$ROOT/shards"
+python scripts/make_tokenized_shards.py --out "$SHARDS" \
+  --num-shards 4 --records-per-shard 64 --seq-len 32 --vocab-size 512 \
+  > /dev/null
+
 PASS=0; FAIL=0
 declare -a SUMMARY
 
@@ -124,9 +140,9 @@ validate() {  # validate <dir> -> validator exit code
     --results-dir "$1/results" > "$1/validate.log" 2>&1
 }
 
-check_recovered() {  # check_recovered <fault> <dir>
-  local fault="$1" dir="$2"
-  if ! run_arm "$dir" "$dir/resume.log" --resume; then
+check_recovered() {  # check_recovered <fault> <dir> [extra harness flags...]
+  local fault="$1" dir="$2"; shift 2
+  if ! run_arm "$dir" "$dir/resume.log" --resume "$@"; then
     fail "$fault" "resume attempt did not complete (see $dir/resume.log)"
     return
   fi
@@ -522,6 +538,119 @@ PYEOF
         fail "$fault" "no result scraped after the recovery relaunch"; continue
       fi
       ok "$fault" "coordinator death -> Indexed Job relaunched -> result recovered"
+      ;;
+    data-corrupt-record)
+      # Streaming-data heal arm (docs/FAULT_TOLERANCE.md): one record's
+      # payload bit-rots in flight; the CRC check quarantines it, the
+      # slot heals by substitution, and the run COMPLETES with an honest
+      # records_skipped=1 ledger that validate_results cross-checks
+      # against the data_corrupt_record telemetry event.
+      run_arm "$dir" "$dir/phase1.log" --data-path "$SHARDS" \
+        --inject-fault "data-corrupt-record@9"
+      rc=$?
+      if [ "$rc" -ne 0 ]; then
+        fail "$fault" "corrupt record must heal in-stream (rc=0), got rc=$rc"; continue
+      fi
+      row="$dir/results/result_ddp_ws1_seq32_tierS.json"
+      if [ ! -f "$row" ]; then fail "$fault" "no result row"; continue; fi
+      if ! python - "$row" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["data_mode"] == "stream", r["data_mode"]
+assert r["records_skipped"] == 1, f"records_skipped={r['records_skipped']}"
+assert r["records_consumed"] == r["steps"], "cursor arithmetic broke"
+EOF
+      then fail "$fault" "healed row missing honest skip ledger"; continue; fi
+      if ! grep -aq '"event": "data_corrupt_record"' \
+           "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "telemetry missing the data_corrupt_record event"; continue
+      fi
+      if ! validate "$dir"; then
+        fail "$fault" "validate_results rejected the healed row (see $dir/validate.log)"
+        continue
+      fi
+      ok "$fault" "corrupt record quarantined + substituted; ledger validated"
+      ;;
+    data-stall)
+      # Input-source outage: the producer goes silent before step 9's
+      # batch; the loop must classify reason=data_stall (exit 78 — NOT
+      # the watchdog's hang), leave an emergency checkpoint + stream
+      # sidecar, salvage a reason=data_stall partial, and the resume must
+      # consume exactly the un-consumed records (validated cursor).
+      timeout -k 5 "${CHAOS_HANG_TIMEOUT:-60}" \
+        "${HARNESS[@]}" --results-dir "$dir/results" \
+        --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+        --data-path "$SHARDS" --data-stall-timeout-sec 5 \
+        --inject-fault "data-stall@9:600" > "$dir/phase1.log" 2>&1
+      rc=$?
+      if [ "$rc" -ne 78 ]; then
+        fail "$fault" "expected EXIT_DATA_STALL (78), got rc=$rc"; continue
+      fi
+      if ! grep -aq '"event": "run_aborted".*"reason": "data_stall"' \
+           "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "no run_aborted reason=data_stall telemetry event"; continue
+      fi
+      if ! scripts/collect_results.sh --log "$dir/phase1.log" \
+           "$dir/salvage" > "$dir/collect.log" 2>&1; then
+        fail "$fault" "heartbeat salvage failed (see $dir/collect.log)"; continue
+      fi
+      if ! grep -q '"reason": "data_stall"' "$dir/salvage"/partial_*.json; then
+        fail "$fault" "salvaged partial row not classified reason=data_stall"; continue
+      fi
+      check_recovered "$fault" "$dir" --data-path "$SHARDS"
+      if ! python - "$dir/results/result_ddp_ws1_seq32_tierS.json" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["data_mode"] == "stream", r["data_mode"]
+expected = (r["resume_step"] + 1)  # 1 record/step at this geometry
+assert r["stream_cursor_start"] == expected, \
+    f"cursor_start={r['stream_cursor_start']} != {expected}"
+EOF
+      then fail "$fault" "resumed stream did not continue at the exact cursor"; fi
+      ;;
+    data-slow-reader)
+      # Degraded-mount arm: every record read from record 4 on takes
+      # +40 ms. The run must COMPLETE (degrade, never die) with an
+      # honest, visibly elevated data_stall_frac — the metric the gate
+      # polices as a secondary (regress.stats.SECONDARY_METRICS).
+      run_arm "$dir" "$dir/phase1.log" --data-path "$SHARDS" \
+        --inject-fault "data-slow-reader@4:40"
+      rc=$?
+      if [ "$rc" -ne 0 ]; then
+        fail "$fault" "slow reader must degrade, not kill (rc=$rc)"; continue
+      fi
+      row="$dir/results/result_ddp_ws1_seq32_tierS.json"
+      if [ ! -f "$row" ]; then fail "$fault" "no result row"; continue; fi
+      if ! python - "$row" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["data_mode"] == "stream", r["data_mode"]
+assert r["data_stall_frac"] is not None and r["data_stall_frac"] > 0.02, \
+    f"data_stall_frac={r['data_stall_frac']} — the degradation is invisible"
+EOF
+      then fail "$fault" "row does not carry the measured input-boundedness"; continue; fi
+      if ! validate "$dir"; then
+        fail "$fault" "validate_results rejected the degraded row"; continue
+      fi
+      ok "$fault" "reader degraded; run completed with measured data_stall_frac"
+      ;;
+    data-missing-shard)
+      # A hole in the corpus: the stream must refuse loudly, naming the
+      # shard, BEFORE any device work — never train on a silently
+      # truncated dataset.
+      run_arm "$dir" "$dir/phase1.log" --data-path "$SHARDS" \
+        --inject-fault "data-missing-shard@2"
+      rc=$?
+      if [ "$rc" -eq 0 ]; then
+        fail "$fault" "run trained on a truncated corpus (rc=0)"; continue
+      fi
+      if ! grep -q "missing shard 2" "$dir/phase1.log"; then
+        fail "$fault" "refusal does not name the missing shard"; continue
+      fi
+      if ls "$dir/results"/result_*.json >/dev/null 2>&1; then
+        fail "$fault" "a result row was published despite the refusal"; continue
+      fi
+      ok "$fault" "incomplete shard set refused loudly, naming shard 2"
       ;;
     enospc-on-save)
       run_arm "$dir" "$dir/phase1.log" --inject-fault "enospc-on-save"

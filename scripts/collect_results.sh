@@ -57,8 +57,10 @@ d["n_heartbeats"] = int(os.environ.get("N_HEARTBEATS", "0"))
 # emergency checkpoint's metadata (step/loss at the save boundary), which
 # supersedes the older cadenced heartbeat's step. A hang-watchdog abort
 # (exit 76) likewise prints a final reason=hang heartbeat before dying,
-# so hung arms classify as reason=hang beside preempted|crash. Anything
-# without a reason died uncleanly: a crash, not a preemption or a hang.
+# and an input-starved streaming run (exit 78, data/stream.py) prints a
+# final reason=data_stall one, so those arms classify as
+# reason=hang|data_stall beside preempted|crash. Anything without a
+# reason died uncleanly: a crash, not a preemption, hang, or data stall.
 d.setdefault("reason", "crash")
 if d.get("emergency_checkpoint_step") is not None:
     d["step"] = d["emergency_checkpoint_step"]

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Generate tokenized record shards for the streaming data path.
+
+Writes a complete ``shard_{i:05d}-of-{n:05d}.tokrec`` set (the format
+``data/stream.py`` reads: magic + JSON header + CRC32-framed fixed-size
+int32 records) plus a ``MANIFEST.json`` describing the generation.
+Deterministic: shard i's tokens come from ``numpy``'s PCG64 seeded with
+``seed + i``, so any shard can be regenerated independently and the
+frozen test fixtures (``tests/fixtures/shards/``) byte-reproduce.
+
+Dev/smoke usage (the chaos suite generates its own set per run):
+
+    python scripts/make_tokenized_shards.py --out /tmp/shards \\
+        --num-shards 4 --records-per-shard 64 --seq-len 32 --vocab-size 512
+
+No jax dependency — the generator is pure host numpy, runnable anywhere
+(including inside containers before the accelerator is up).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_llm_training_benchmark_framework_tpu.data.stream import (  # noqa: E402
+    shard_filename,
+    write_shard,
+)
+
+
+def make_shards(
+    out_dir: str,
+    *,
+    num_shards: int,
+    records_per_shard: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 42,
+) -> dict:
+    """Write the shard set + MANIFEST.json; returns the manifest dict."""
+    if num_shards <= 0 or records_per_shard <= 0:
+        raise ValueError("num_shards and records_per_shard must be > 0")
+    os.makedirs(out_dir, exist_ok=True)
+    for i in range(num_shards):
+        rng = np.random.default_rng(seed + i)
+        tokens = rng.integers(
+            0, vocab_size, size=(records_per_shard, seq_len), dtype=np.int32
+        )
+        write_shard(
+            os.path.join(out_dir, shard_filename(i, num_shards)),
+            tokens,
+            shard_index=i,
+            num_shards=num_shards,
+            vocab_size=vocab_size,
+            seed=seed + i,
+        )
+    manifest = {
+        "schema_version": 1,
+        "num_shards": num_shards,
+        "records_per_shard": records_per_shard,
+        "total_records": num_shards * records_per_shard,
+        "seq_len": seq_len,
+        "vocab_size": vocab_size,
+        "seed": seed,
+        "generator": "scripts/make_tokenized_shards.py",
+    }
+    with open(os.path.join(out_dir, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return manifest
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--num-shards", type=int, default=4)
+    p.add_argument("--records-per-shard", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--vocab-size", type=int, default=512)
+    p.add_argument("--seed", type=int, default=42)
+    args = p.parse_args(argv)
+    manifest = make_shards(
+        args.out,
+        num_shards=args.num_shards,
+        records_per_shard=args.records_per_shard,
+        seq_len=args.seq_len,
+        vocab_size=args.vocab_size,
+        seed=args.seed,
+    )
+    print(
+        f"Wrote {manifest['num_shards']} shards x "
+        f"{manifest['records_per_shard']} records (seq_len "
+        f"{manifest['seq_len']}, vocab {manifest['vocab_size']}) -> {args.out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

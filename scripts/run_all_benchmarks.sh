@@ -96,9 +96,11 @@ SKIP_REGRESS="${SKIP_REGRESS:-0}"
 # Chaos smoke (scripts/chaos_suite.sh --smoke, docs/FAULT_TOLERANCE.md):
 # before burning slice time on the matrix, prove in ~a minute on the host
 # CPU that the recovery machinery works — a SIGKILL'd arm resumes from
-# its checkpoint, a torn checkpoint quarantines + falls back, and a
+# its checkpoint, a torn checkpoint quarantines + falls back, a
 # bitflip-poisoned arm is healed in-process by the numerics sentinel
-# (rollback + replay, n_rollbacks=1, validated). Runs in a throwaway
+# (rollback + replay, n_rollbacks=1, validated), and a corrupt record on
+# the streaming data path quarantines + substitutes with an honest
+# records_skipped ledger. Runs in a throwaway
 # tmpdir so its artifacts never pollute RESULTS_DIR, the registry, or
 # the report. SKIP_CHAOS=1 bypasses (same escape hatch as
 # SKIP_PREFLIGHT/SKIP_REGRESS); dry runs plan only and skip it too.
@@ -197,7 +199,7 @@ if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_PREFLIGHT" != "1" ]; then
 fi
 
 if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_CHAOS" != "1" ]; then
-  echo "=== Chaos smoke: recovery proof (sigkill + torn-checkpoint + bitflip-heal + elastic) ==="
+  echo "=== Chaos smoke: recovery proof (sigkill + torn-checkpoint + bitflip-heal + corrupt-record stream heal + elastic) ==="
   CHAOS_DIR=$(mktemp -d /tmp/chaos_smoke.XXXXXX)
   # --elastic: the geometry-change resume proof (save@dp4 -> resume@dp2 ->
   # validate_results passes with resume_geometry_changed=true) rides the

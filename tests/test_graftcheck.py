@@ -116,7 +116,7 @@ def test_lint_is_clean_on_head():
 def test_rule_catalog_is_complete():
     assert set(lint.RULES) == {
         "GC101", "GC102", "GC103", "GC104", "GC105", "GC106", "GC107",
-        "GC108", "GC109", "GC201",
+        "GC108", "GC109", "GC111", "GC201",
     }
     for rule in lint.RULES.values():
         assert rule.fix_hint and rule.description
@@ -1384,6 +1384,76 @@ def test_gc109_honors_suppression_and_ignores_non_range_loops(tmp_path):
 
 def test_gc109_clean_on_head():
     assert lint.run_lint(rules=("GC109",)) == []
+
+
+# ---------------------------------------------------------------------------
+# GC111: blocking input IO / host-iterator pulls in the timed loop
+# ---------------------------------------------------------------------------
+
+
+def test_gc111_fires_on_blocking_io_and_next_in_timed_loop(tmp_path):
+    """Direct file reads, next() pulls and sleeps inside the timed loop
+    are flagged; the prefetch fence (any *prefetch* receiver) and a
+    sync_window-fenced tail are sanctioned."""
+    root = _scratch_root(tmp_path, "train/loop.py", """\
+        import time
+
+        def run(steps, step_fn, state, it, f, prefetch):
+            def sync_window():
+                pass
+
+            for step in range(steps):
+                batch = next(it)
+                raw = f.read(128)
+                f.seek(0)
+                time.sleep(0.01)
+                with open("/data/shard") as g:
+                    pass
+                good, meta, waited = prefetch.get(step, timeout=5)
+                state = step_fn(state, good)
+                if step % 10 == 0:
+                    sync_window()
+                    f.read(128)  # fenced: after the sync in this block
+            return state
+    """)
+    violations = lint.run_lint(root=root, rules=("GC111",))
+    assert [v.line for v in violations] == [8, 9, 10, 11, 12]
+    assert {v.rule_id for v in violations} == {"GC111"}
+    msgs = "\n".join(v.message for v in violations)
+    assert "next() host-iterator pull" in msgs
+    assert ".read()" in msgs and ".seek()" in msgs
+    assert "time.sleep()" in msgs and "open()" in msgs
+    assert "prefetch" in violations[0].fix_hint
+
+
+def test_gc111_scans_data_package_and_honors_suppression(tmp_path):
+    root = _scratch_root(tmp_path, "data/scratch.py", """\
+        def consume(steps, it):
+            out = []
+            for step in range(steps):
+                out.append(next(it))
+                out.append(next(it))  # graftcheck: disable=GC111
+            return out
+    """)
+    violations = lint.run_lint(root=root, rules=("GC111",))
+    assert [v.line for v in violations] == [4]
+
+
+def test_gc111_ignores_non_step_loops(tmp_path):
+    """The producer thread's own loop (data/prefetch.py) legitimately
+    blocks — only the timed `for step` shape is policed."""
+    root = _scratch_root(tmp_path, "data/scratch.py", """\
+        def produce(n, it):
+            out = []
+            for produced in range(n):
+                out.append(next(it))
+            return out
+    """)
+    assert lint.run_lint(root=root, rules=("GC111",)) == []
+
+
+def test_gc111_clean_on_head():
+    assert lint.run_lint(rules=("GC111",)) == []
 
 
 # ---------------------------------------------------------------------------

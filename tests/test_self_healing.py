@@ -710,7 +710,10 @@ def test_suite_smoke_includes_bitflip_and_escape_hatch():
                               "run_all_benchmarks.sh")).read()
     assert "SKIP_CHAOS" in suite and "chaos_suite.sh --smoke" in suite
     chaos = open(os.path.join(REPO, "scripts", "chaos_suite.sh")).read()
-    assert 'FAULTS="sigkill torn-checkpoint bitflip"' in chaos
+    # The smoke roster: crash-resume, torn-checkpoint fallback, the
+    # sentinel heal, and (streaming round) the corrupt-record stream heal.
+    assert ('FAULTS="sigkill torn-checkpoint bitflip '
+            'data-corrupt-record"') in chaos
 
 
 def test_entrypoint_plumbs_self_healing_knobs():

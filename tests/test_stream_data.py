@@ -1,0 +1,843 @@
+"""Streaming data path: shard format, healing, exact resume, gating.
+
+The resilient-input round's tier-1 matrix (docs/FAULT_TOLERANCE.md):
+
+- **format**: TOKREC01 write/read round-trip, bad-magic refusal, and the
+  byte-frozen fixture set (``tests/fixtures/shards/``) pinning the
+  on-disk schema the way ``telemetry_frozen.jsonl`` pins events;
+- **robustness core**: corrupt-record skip-and-quarantine with the
+  honest ledger (real on-disk bit-rot, not just the injector), bounded
+  retry/backoff on transient read errors, loud missing-shard refusal
+  naming the shard;
+- **exact resume**: the geometry-independent cursor (state_dict/seek
+  round-trip, epoch wrap), the checkpoint ``stream_<step>.json`` sidecar
+  (written, quarantined with its step, read back), and a REAL subprocess
+  SIGKILL-mid-stream round trip whose resume consumes exactly the
+  un-consumed records (ledger-verified, validate_results PASS — the
+  acceptance proof);
+- **fault grammar + hooks**: the four ``data-*`` chaos specs parse,
+  round-trip, reject junk, and their injector hooks fire exactly once at
+  their pinned record/step;
+- **prefetcher**: ordered production with per-batch resume snapshots,
+  starvation measurement, DataStallTimeout classification, and
+  producer-error surfacing;
+- **accounting**: recorder data fields (heartbeats/run_end; synthetic
+  runs byte-unchanged), the validate_results data-path coherence
+  envelope, the telemetry_report stall timeline, and the
+  ``data_stall_frac`` secondary-metric gate proof (injected regression
+  fails ``regress gate --all`` naming the metric; A/A stays quiet).
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_SHARDS = os.path.join(REPO, "tests", "fixtures", "shards")
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+from make_tokenized_shards import make_shards  # noqa: E402
+
+from distributed_llm_training_benchmark_framework_tpu import faults  # noqa: E402
+from distributed_llm_training_benchmark_framework_tpu.analysis import (  # noqa: E402
+    validate_results as vr,
+)
+from distributed_llm_training_benchmark_framework_tpu.data import (  # noqa: E402
+    DataStallTimeout,
+    HostPrefetcher,
+    MissingShardError,
+    ShardedTokenStream,
+)
+from distributed_llm_training_benchmark_framework_tpu.data import stream as ds  # noqa: E402
+
+#: sha256 digests of the frozen fixture set (tests/fixtures/shards/README.md
+#: has the regeneration command; a mismatch means the on-disk format changed
+#: without a schema bump).
+FROZEN_DIGESTS = {
+    "shard_00000-of-00003.tokrec":
+        "b45249c213abec5aa13ec72a6f68ce1449069aa8c360b892c912aebd41800795",
+    "shard_00001-of-00003.tokrec":
+        "eaf19fab0b7e4f9bb2681f9e01aac73f16459d28142fc781109f073884c4057c",
+    "shard_00002-of-00003.tokrec":
+        "f6bde82cadf22b02629ef41a9503fd33301ec2dbaeb56f7feabd4985d089e444",
+}
+
+
+@pytest.fixture()
+def shard_dir(tmp_path):
+    out = tmp_path / "shards"
+    make_shards(str(out), num_shards=4, records_per_shard=16, seq_len=32,
+                vocab_size=512, seed=42)
+    return str(out)
+
+
+# ---------------------------------------------------------------------------
+# Format
+# ---------------------------------------------------------------------------
+
+
+def test_shard_write_read_roundtrip(tmp_path):
+    tokens = np.arange(6 * 8, dtype=np.int32).reshape(6, 8)
+    path = str(tmp_path / ds.shard_filename(0, 1))
+    ds.write_shard(path, tokens, shard_index=0, num_shards=1, vocab_size=64)
+    header, offset = ds.read_shard_header(path)
+    assert header["n_records"] == 6 and header["seq_len"] == 8
+    stream = ShardedTokenStream(str(tmp_path))
+    np.testing.assert_array_equal(stream.read_records(0, 6), tokens)
+
+
+def test_bad_magic_refused(tmp_path):
+    path = tmp_path / ds.shard_filename(0, 1)
+    path.write_bytes(b"NOTAREC0" + b"\x00" * 64)
+    with pytest.raises(ds.DataReadError, match="bad shard magic"):
+        ShardedTokenStream(str(tmp_path))
+
+
+def test_frozen_fixture_shards_byte_stable():
+    for name, digest in FROZEN_DIGESTS.items():
+        path = os.path.join(FIXTURE_SHARDS, name)
+        actual = hashlib.sha256(open(path, "rb").read()).hexdigest()
+        assert actual == digest, (
+            f"{name} changed on disk — the TOKREC01 format drifted without "
+            "a schema bump (see tests/fixtures/shards/README.md)"
+        )
+    stream = ShardedTokenStream(FIXTURE_SHARDS)
+    assert stream.total_records == 24 and stream.seq_len == 16
+    batch = stream.next_batch(24)
+    assert batch.shape == (24, 16)
+    assert stream.records_skipped == 0
+
+
+def test_generator_is_deterministic(tmp_path):
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    for out in (a, b):
+        make_shards(out, num_shards=2, records_per_shard=4, seq_len=8,
+                    vocab_size=32, seed=9)
+    for name in sorted(os.listdir(a)):
+        assert open(os.path.join(a, name), "rb").read() == \
+            open(os.path.join(b, name), "rb").read(), name
+
+
+def test_generator_cli(tmp_path, capsys):
+    import make_tokenized_shards as gen
+
+    rc = gen.main(["--out", str(tmp_path / "o"), "--num-shards", "2",
+                   "--records-per-shard", "3", "--seq-len", "8",
+                   "--vocab-size", "32"])
+    assert rc == 0
+    assert "2 shards x 3 records" in capsys.readouterr().out
+    manifest = json.load(open(tmp_path / "o" / "MANIFEST.json"))
+    assert manifest["total_records"] == 6
+
+
+# ---------------------------------------------------------------------------
+# Discovery refusals
+# ---------------------------------------------------------------------------
+
+
+def test_missing_shard_refused_naming_it(shard_dir):
+    os.remove(os.path.join(shard_dir, ds.shard_filename(2, 4)))
+    with pytest.raises(MissingShardError, match="missing shard 2 of 4"):
+        ShardedTokenStream(shard_dir)
+
+
+def test_empty_dir_refused(tmp_path):
+    with pytest.raises(MissingShardError, match="no shard_"):
+        ShardedTokenStream(str(tmp_path))
+
+
+def test_seq_len_mismatch_refused(shard_dir):
+    with pytest.raises(ValueError, match="seq_len=32"):
+        ShardedTokenStream(shard_dir, seq_len=64)
+
+
+def test_mixed_shard_sets_refused(shard_dir):
+    shutil.copy(
+        os.path.join(shard_dir, ds.shard_filename(0, 4)),
+        os.path.join(shard_dir, ds.shard_filename(0, 5)),
+    )
+    with pytest.raises(MissingShardError, match="mixed shard sets"):
+        ShardedTokenStream(shard_dir)
+
+
+# ---------------------------------------------------------------------------
+# Cursor / exact resume / epoch wrap
+# ---------------------------------------------------------------------------
+
+
+def test_cursor_state_roundtrip_and_epoch_wrap(shard_dir):
+    a = ShardedTokenStream(shard_dir)
+    first = a.next_batch(5)
+    state = a.state_dict()
+    assert state["cursor"] == 5 and state["records_skipped"] == 0
+
+    b = ShardedTokenStream(shard_dir)
+    b.seek(state["cursor"])
+    np.testing.assert_array_equal(b.next_batch(3), a.next_batch(3))
+
+    # Epoch wrap: global index past total_records re-reads from the top.
+    c = ShardedTokenStream(shard_dir)
+    wrapped = c.read_records(c.total_records, c.total_records + 5)
+    np.testing.assert_array_equal(wrapped, first)
+
+
+def test_geometry_independent_global_order(shard_dir):
+    """The delivered stream is one global order: any host reading its
+    slice of a batch sees the same records as a whole-batch reader —
+    per-host ownership is a VIEW of the cursor, never its own state."""
+    whole = ShardedTokenStream(shard_dir).read_records(8, 16)
+    parts = [
+        ShardedTokenStream(shard_dir).read_records(8 + lo, 8 + hi)
+        for lo, hi in ((0, 4), (4, 8))  # two "hosts" at dp=2
+    ]
+    np.testing.assert_array_equal(np.concatenate(parts, axis=0), whole)
+
+
+# ---------------------------------------------------------------------------
+# Corruption healing + retry
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_record_on_disk(shard_dir, shard_idx, record, num_shards=4):
+    path = os.path.join(shard_dir, ds.shard_filename(shard_idx, num_shards))
+    header, offset = ds.read_shard_header(path)
+    rec_bytes = 4 + header["seq_len"] * 4
+    pos = offset + record * rec_bytes + 4 + 2  # a payload byte
+    with open(path, "r+b") as f:
+        f.seek(pos)
+        byte = f.read(1)
+        f.seek(pos)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+def test_real_disk_bitrot_heals_with_ledger(shard_dir):
+    _corrupt_record_on_disk(shard_dir, 0, 3)
+    stream = ShardedTokenStream(shard_dir)
+    out = stream.read_records(0, 8)
+    assert stream.records_skipped == 1
+    ledger = stream.drain_quarantine()
+    assert ledger == [{
+        "epoch": 0, "shard": 0, "record": 3, "global_index": 3,
+        "reason": "crc_mismatch", "substitute_record": 2,
+    }]
+    assert stream.drain_quarantine() == []  # drained exactly once
+    # The slot healed with the nearest previous VALID record.
+    np.testing.assert_array_equal(out[3], out[2])
+    # Re-reading re-skips (each delivery of the bad slot is ledgered).
+    stream.read_records(3, 4)
+    assert stream.records_skipped == 2
+
+
+def test_whole_shard_corrupt_fails_loudly(tmp_path):
+    out = str(tmp_path / "s")
+    make_shards(out, num_shards=1, records_per_shard=3, seq_len=8,
+                vocab_size=32)
+    for rec in range(3):
+        _corrupt_record_on_disk(out, 0, rec, num_shards=1)
+    stream = ShardedTokenStream(out)
+    with pytest.raises(ds.DataReadError, match="beyond substitution"):
+        stream.read_records(0, 1)
+
+
+def test_transient_read_errors_retry_with_backoff(shard_dir, monkeypatch):
+    stream = ShardedTokenStream(shard_dir, retry_backoff_sec=0.001)
+    orig = stream._file
+    calls = {"n": 0}
+
+    def flaky(shard):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient NFS hiccup")
+        return orig(shard)
+
+    monkeypatch.setattr(stream, "_file", flaky)
+    row = stream.read_records(0, 1)
+    assert row.shape == (1, 32)
+    assert calls["n"] == 3  # two transients + the success
+
+
+def test_read_errors_past_budget_fail_loudly(shard_dir, monkeypatch):
+    stream = ShardedTokenStream(shard_dir, read_retries=2,
+                                retry_backoff_sec=0.001)
+
+    def dead(shard):
+        raise OSError("mount is gone")
+
+    monkeypatch.setattr(stream, "_file", dead)
+    with pytest.raises(ds.DataReadError, match="after 3 attempts"):
+        stream.read_records(0, 1)
+
+
+# ---------------------------------------------------------------------------
+# Fault-spec grammar + injector hooks
+# ---------------------------------------------------------------------------
+
+
+def test_data_fault_specs_parse_and_roundtrip():
+    for spec in ("data-stall@9:600", "data-stall@9",
+                 "data-corrupt-record@8", "data-slow-reader@4:40",
+                 "data-missing-shard@2"):
+        parsed = faults.parse_fault_spec(spec)
+        assert str(parsed) == spec
+        assert parsed.kind in faults.DATA_KINDS
+    assert faults.parse_fault_spec("data-slow-reader@4:40").delay_ms == 40.0
+    assert faults.parse_fault_spec("data-stall@9:600").hang_sec == 600.0
+
+
+@pytest.mark.parametrize("bad", [
+    "data-stall",               # stepped kind without a step
+    "data-corrupt-record@5:9",  # suffix on a suffix-less kind
+    "data-slow-reader@4",       # latency suffix is mandatory
+    "data-slow-reader@4:0",     # non-positive latency
+    "data-stall@9:0",           # non-positive stall
+    "data-missing-shard@-1",    # negative shard index
+])
+def test_data_fault_specs_reject(bad):
+    with pytest.raises(ValueError):
+        faults.parse_fault_spec(bad)
+
+
+def test_injector_data_hooks_fire_at_pinned_points():
+    inj = faults.FaultInjector(
+        faults.parse_fault_spec("data-corrupt-record@5"))
+    payload = bytes(range(16))
+    assert inj.data_corrupt_payload(4, payload) == payload
+    poisoned = inj.data_corrupt_payload(5, payload)
+    assert poisoned != payload and len(poisoned) == len(payload)
+    # Fires exactly once.
+    assert inj.data_corrupt_payload(5, payload) == payload
+
+    slow = faults.FaultInjector(
+        faults.parse_fault_spec("data-slow-reader@4:40"))
+    assert slow.data_read_delay_sec(3) == 0.0
+    assert slow.data_read_delay_sec(4) == pytest.approx(0.04)
+    assert slow.data_read_delay_sec(9) == pytest.approx(0.04)  # persists
+
+    stall = faults.FaultInjector(faults.parse_fault_spec("data-stall@9:7"))
+    assert stall.data_stall_sec(8) == 0.0
+    assert stall.data_stall_sec(9) == 7.0
+    assert stall.data_stall_sec(9) == 0.0  # fires exactly once
+
+    missing = faults.FaultInjector(
+        faults.parse_fault_spec("data-missing-shard@2"))
+    assert missing.data_missing_shard() == 2
+    inert = faults.FaultInjector(None)
+    assert inert.data_missing_shard() is None
+    assert inert.data_stall_sec(0) == 0.0
+    assert inert.data_corrupt_payload(0, b"x") == b"x"
+    assert inert.data_read_delay_sec(0) == 0.0
+
+
+def test_data_fault_without_data_path_refused():
+    """A data-* spec with no stream has no consumer: the run would train
+    normally and exit 0 while the chaos report claimed the fault was
+    survived — refuse loudly instead (review finding)."""
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import (
+        run_benchmark,
+    )
+
+    with pytest.raises(ValueError, match="requires --data-path"):
+        run_benchmark(
+            strategy=get_strategy("ddp"), tier="S", seq_len=32, steps=4,
+            warmup_steps=1, per_device_batch=1, grad_accum=1, world_size=1,
+            results_dir=None, inject_fault="data-corrupt-record@2",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Prefetcher
+# ---------------------------------------------------------------------------
+
+
+def _batch_sharding():
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("data",))
+    return NamedSharding(mesh, P())
+
+
+def test_prefetcher_produces_in_order_with_resume_snapshots(shard_dir):
+    stream = ShardedTokenStream(shard_dir)
+    pf = HostPrefetcher(
+        stream, sharding=_batch_sharding(), grad_accum=2, global_micro=3,
+        seq_len=32, start_step=0, stop_step=4,
+    ).start()
+    try:
+        ref = ShardedTokenStream(shard_dir)
+        for step in range(4):
+            arr, meta, waited = pf.get(step, timeout=30.0)
+            assert arr.shape == (2, 3, 32)
+            assert meta["step"] == step
+            assert meta["cursor"] == (step + 1) * 6
+            assert waited >= 0.0
+            np.testing.assert_array_equal(
+                np.asarray(arr).reshape(6, 32),
+                ref.read_records(step * 6, (step + 1) * 6),
+            )
+    finally:
+        pf.stop()
+
+
+def test_prefetcher_stall_classifies_as_timeout(shard_dir):
+    inj = faults.FaultInjector(faults.parse_fault_spec("data-stall@0:30"))
+    stream = ShardedTokenStream(shard_dir, injector=inj)
+    pf = HostPrefetcher(
+        stream, sharding=_batch_sharding(), grad_accum=1, global_micro=1,
+        seq_len=32, start_step=0, stop_step=2, injector=inj,
+    ).start()
+    try:
+        with pytest.raises(DataStallTimeout) as exc:
+            pf.get(0, timeout=0.5)
+        assert exc.value.step == 0 and exc.value.waited_sec >= 0.5
+    finally:
+        pf.stop()
+
+
+def test_prefetcher_surfaces_producer_errors(shard_dir, monkeypatch):
+    stream = ShardedTokenStream(shard_dir)
+
+    def dead(start, stop):
+        raise ds.DataReadError("mount is gone")
+
+    monkeypatch.setattr(stream, "read_records", dead)
+    pf = HostPrefetcher(
+        stream, sharding=_batch_sharding(), grad_accum=1, global_micro=1,
+        seq_len=32, start_step=0, stop_step=2,
+    ).start()
+    try:
+        with pytest.raises(ds.DataReadError, match="mount is gone"):
+            pf.get(0, timeout=10.0)
+    finally:
+        pf.stop()
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint stream sidecar
+# ---------------------------------------------------------------------------
+
+
+def _tiny_trees():
+    return ({"w": np.ones((2, 2), np.float32)},
+            {"m": np.zeros((2, 2), np.float32)})
+
+
+def test_checkpoint_stream_sidecar_roundtrip_and_quarantine(tmp_path):
+    from distributed_llm_training_benchmark_framework_tpu.runtime.checkpoint import (
+        BenchmarkCheckpointer,
+    )
+
+    params, opt = _tiny_trees()
+    ckpt = BenchmarkCheckpointer(str(tmp_path / "ckpt"), save_every=1)
+    state = {"schema_version": 1, "cursor": 40, "records_skipped": 2,
+             "total_records": 64}
+    assert ckpt.save(4, params, opt, stream_state=state)
+    assert ckpt.read_stream_state(4) == state
+    assert ckpt.read_stream_state(5) is None  # absent -> synthetic posture
+
+    # A quarantined step takes its stream sidecar with it.
+    dest = ckpt.quarantine_step(4, "test")
+    assert ckpt.read_stream_state(4) is None
+    assert os.path.exists(os.path.join(dest, "stream_4.json"))
+    ckpt.close()
+
+
+def test_checkpoint_sidecar_ignores_newer_schema(tmp_path):
+    from distributed_llm_training_benchmark_framework_tpu.runtime.checkpoint import (
+        BenchmarkCheckpointer,
+    )
+
+    params, opt = _tiny_trees()
+    ckpt = BenchmarkCheckpointer(str(tmp_path / "ckpt"), save_every=1)
+    ckpt.save(1, params, opt,
+              stream_state={"schema_version": 99, "cursor": 7})
+    assert ckpt.read_stream_state(1) is None  # newer writer: cannot judge
+    ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# Recorder accounting
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_data_fields_on_stream_windows(tmp_path, capsys):
+    from distributed_llm_training_benchmark_framework_tpu.telemetry import (
+        TelemetryRecorder,
+        parse_heartbeat_line,
+        read_events,
+    )
+
+    rec = TelemetryRecorder(
+        "stream_arm", results_dir=str(tmp_path), heartbeat_every_sec=0,
+        tokens_per_step=32,
+    )
+    rec.begin_phase("timed")
+    rec.step_window(last_step=1, losses=[5.0], window_mean_step_time_sec=0.2,
+                    data_wait_sec=0.1, records_skipped=0)
+    rec.step_window(last_step=3, losses=[4.9], window_mean_step_time_sec=0.2,
+                    data_wait_sec=0.0, records_skipped=2)
+    assert rec.data_stall_frac == pytest.approx(0.25)
+    rec.close("ok")
+
+    events = read_events(os.path.join(str(tmp_path),
+                                      "telemetry_stream_arm.jsonl"))
+    windows = [e for e in events if e["event"] == "step_window"]
+    assert windows[0]["data_wait_sec"] == 0.1
+    assert windows[1]["records_skipped"] == 2
+    end = next(e for e in events if e["event"] == "run_end")
+    assert end["data_stall_frac"] == pytest.approx(0.25)
+    assert end["records_skipped"] == 2
+    beats = [parse_heartbeat_line(l)
+             for l in capsys.readouterr().out.splitlines()
+             if parse_heartbeat_line(l)]
+    assert beats and beats[-1]["data_stall_frac"] == pytest.approx(0.25)
+    assert beats[-1]["records_skipped"] == 2
+
+
+def test_recorder_synthetic_windows_carry_no_data_fields(tmp_path, capsys):
+    from distributed_llm_training_benchmark_framework_tpu.telemetry import (
+        TelemetryRecorder,
+        parse_heartbeat_line,
+        read_events,
+    )
+
+    rec = TelemetryRecorder(
+        "synth_arm", results_dir=str(tmp_path), heartbeat_every_sec=0,
+    )
+    rec.begin_phase("timed")
+    rec.step_window(last_step=1, losses=[5.0], window_mean_step_time_sec=0.2)
+    assert rec.data_stall_frac is None
+    rec.close("ok")
+    events = read_events(os.path.join(str(tmp_path),
+                                      "telemetry_synth_arm.jsonl"))
+    for e in events:
+        assert "data_wait_sec" not in e
+        assert "data_stall_frac" not in e
+    beat = next(parse_heartbeat_line(l)
+                for l in capsys.readouterr().out.splitlines()
+                if parse_heartbeat_line(l))
+    assert "data_stall_frac" not in beat
+
+
+# ---------------------------------------------------------------------------
+# validate_results data-path envelope
+# ---------------------------------------------------------------------------
+
+
+def _stream_row(**over):
+    row = {
+        "strategy": "ddp", "world_size": 1, "rank": 0, "seq_len": 32,
+        "tier": "S", "steps": 10, "per_device_batch": 1, "grad_accum": 1,
+        "tokens_per_sec": 1000.0, "mean_step_time_sec": 0.03,
+        "mean_loss": 5.5, "peak_vram_gb": 0.1, "h2d_gbps_per_gpu": 1e-4,
+        "data_mode": "stream", "data_stall_frac": 0.01,
+        "data_stall_sec": 0.01, "records_consumed": 10,
+        "records_skipped": 0, "stream_cursor_start": 0,
+        "stream_cursor_end": 10,
+    }
+    row.update(over)
+    return row
+
+
+def test_validator_accepts_coherent_stream_row():
+    assert vr.validate_result(_stream_row(), "row") == []
+
+
+def test_validator_rejects_stall_frac_out_of_range():
+    fails = vr.validate_result(_stream_row(data_stall_frac=1.7), "row")
+    assert any("data_stall_frac" in f for f in fails)
+    fails = vr.validate_result(_stream_row(data_stall_frac=None), "row")
+    assert any("data_stall_frac" in f for f in fails)
+
+
+def test_validator_rejects_cursor_incoherence():
+    fails = vr.validate_result(_stream_row(stream_cursor_end=12), "row")
+    assert any("records_consumed" in f or "incoherent" in f for f in fails)
+    fails = vr.validate_result(
+        _stream_row(records_consumed=8, stream_cursor_end=8), "row")
+    assert any("replayed or skipped" in f for f in fails)
+
+
+def test_validator_checks_resume_cursor_continuity():
+    good = _stream_row(
+        resumed=True, n_restarts=1, resume_step=4,
+        stream_cursor_start=5, stream_cursor_end=10, records_consumed=5,
+    )
+    assert vr.validate_result(good, "row") == []
+    bad = _stream_row(
+        resumed=True, n_restarts=1, resume_step=4,
+        stream_cursor_start=3, stream_cursor_end=8, records_consumed=5,
+    )
+    fails = vr.validate_result(bad, "row")
+    assert any("stitch replayed or skipped" in f for f in fails)
+    # Geometry-change stitches skip the cross-run cursor_start check
+    # (records/step changed) but keep the within-run arithmetic.
+    elastic = _stream_row(
+        resumed=True, n_restarts=1, resume_step=4,
+        resume_geometry_changed=True,
+        stream_cursor_start=20, stream_cursor_end=25, records_consumed=5,
+    )
+    assert vr.validate_result(elastic, "row") == []
+    # A LATER same-geometry restart (n_restarts > 1) may sit downstream
+    # of an earlier geometry-change era with a different records/step —
+    # the sidecar cursor is authoritative there, so only the within-run
+    # arithmetic applies.
+    chained = _stream_row(
+        resumed=True, n_restarts=2, resume_step=4,
+        stream_cursor_start=20, stream_cursor_end=25, records_consumed=5,
+    )
+    assert vr.validate_result(chained, "row") == []
+
+
+def test_validator_accepts_resume_from_step_zero():
+    """resume_step=0 is a legitimate restore (a run stalled at step 1
+    checkpoints step 0) and must not collapse to the falsy default
+    (review finding: `or -1` turned it into a cold start)."""
+    row = _stream_row(
+        resumed=True, n_restarts=1, resume_step=0,
+        stream_cursor_start=1, stream_cursor_end=10, records_consumed=9,
+    )
+    assert vr.validate_result(row, "row") == []
+
+
+def test_validator_rejects_data_leak_onto_synthetic_rows():
+    row = _stream_row(data_mode="synthetic")
+    fails = vr.validate_result(row, "row")
+    assert any("input accounting leaked" in f for f in fails)
+
+
+def test_validator_cross_checks_quarantine_events(tmp_path):
+    row = _stream_row(records_skipped=1)
+    rpath = tmp_path / "result_ddp_ws1_seq32_tierS.json"
+    rpath.write_text(json.dumps(row))
+    tpath = tmp_path / "telemetry_ddp_ws1_seq32_tierS.jsonl"
+    events = [
+        {"event": "run_meta", "ts": 0, "rel": 0},
+        {"event": "data_corrupt_record", "ts": 1, "rel": 1, "shard": 0,
+         "record": 3},
+        {"event": "run_end", "ts": 2, "rel": 2, "status": "ok",
+         "n_unresolved_anomalies": 0},
+    ]
+    tpath.write_text("".join(json.dumps(e) + "\n" for e in events))
+    assert vr.validate_telemetry(str(rpath), row, "row") == []
+    # A ledger/trail mismatch in either direction is a violation.
+    row2 = dict(row, records_skipped=3)
+    fails = vr.validate_telemetry(str(rpath), row2, "row")
+    assert any("disagree" in f for f in fails)
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report stall timeline
+# ---------------------------------------------------------------------------
+
+
+def test_report_renders_data_stall_timeline():
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        telemetry_report as tr,
+    )
+
+    events = [
+        {"event": "run_meta", "arm": "a", "ts": 0, "rel": 0},
+        {"event": "phase_begin", "phase": "timed", "ts": 1, "rel": 1},
+        {"event": "step_window", "step": 1, "steps_in_window": 2,
+         "loss": 5.0, "window_mean_step_time_sec": 0.2, "cum_tokens": 10,
+         "tokens_per_sec": 100.0, "phase": "timed", "ts": 2, "rel": 2,
+         "data_wait_sec": 0.3, "records_skipped": 1},
+        {"event": "data_stall", "step": 1, "fatal": False,
+         "wait_sec": 0.3, "ts": 2.1, "rel": 2.1},
+        {"event": "run_end", "status": "ok", "ts": 3, "rel": 3},
+    ]
+    tl = tr.build_timeline(events)
+    assert len(tl["data_events"]) == 1
+    text = tr.format_report(tl)
+    assert "Data-stall timeline" in text
+    assert "data_stall events: 1 (all transient)" in text
+    assert "records skipped/quarantined: 1" in text
+    # Synthetic timelines render no stall section.
+    synth = [e for e in events
+             if e["event"] not in ("data_stall",)]
+    for e in synth:
+        e.pop("data_wait_sec", None)
+        e.pop("records_skipped", None)
+    assert "Data-stall timeline" not in tr.format_report(
+        tr.build_timeline(synth))
+
+
+# ---------------------------------------------------------------------------
+# regress gate: data_stall_frac as a named secondary metric
+# ---------------------------------------------------------------------------
+
+
+def test_gate_flags_data_stall_regression_and_aa_stays_quiet(tmp_path, capsys):
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        compare as rcompare,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        stats as rstats,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.regress import (
+        store as rstore,
+    )
+
+    assert ("data_stall_frac", False, 2.0, "abs_pp") in \
+        rstats.SECONDARY_METRICS
+
+    def row(dsf):
+        return _stream_row(data_stall_frac=dsf)
+
+    def windows():
+        return [{"step": 9 + 5 * i, "steps_in_window": 5, "dt": 0.2,
+                 "loss": 5.5} for i in range(10)]
+
+    reg_dir = str(tmp_path / "reg")
+    reg = rstore.Registry(reg_dir)
+    for i, dsf in enumerate((0.010, 0.012, 0.011, 0.013)):
+        reg.ingest(rstore.make_record(
+            arm="stream_arm", result_row=row(dsf), windows=windows(),
+            tokens_per_step=32, source=f"r{i}",
+        ))
+    # A/A: an in-noise candidate gates clean.
+    reg.ingest(rstore.make_record(
+        arm="stream_arm", result_row=row(0.012), windows=windows(),
+        tokens_per_step=32, source="aa",
+    ))
+    rc = rcompare.main(["--registry", reg_dir, "gate", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+    # Injected input-boundedness: +13 pp of stall, throughput unchanged —
+    # the gate must fail NAMING the metric.
+    reg.ingest(rstore.make_record(
+        arm="stream_arm", result_row=row(0.14), windows=windows(),
+        tokens_per_step=32, source="slow",
+    ))
+    rc = rcompare.main(["--registry", reg_dir, "gate", "--all"])
+    out = capsys.readouterr().out
+    assert rc == 1, out
+    line = next(l for l in out.splitlines() if "REGRESSION" in l)
+    assert "metric=data_stall_frac" in line
+
+
+# ---------------------------------------------------------------------------
+# The acceptance proof: REAL subprocess SIGKILL mid-stream, then resume
+# ---------------------------------------------------------------------------
+
+
+ARM = "ddp_ws1_seq32_tierS"
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("INJECT_FAULT", None)
+    return env
+
+
+def _run_harness(results, ckpt_dir, shards, extra=()):
+    return subprocess.run(
+        [
+            sys.executable, "-u",
+            os.path.join(REPO, "benchmarking", "train_harness.py"),
+            "--strategy", "ddp", "--world-size", "1", "--rank", "0",
+            "--tier", "S", "--seq-len", "32", "--steps", "14",
+            "--warmup-steps", "2", "--per-device-batch", "1",
+            "--grad-accum", "1", "--sync-every", "2", "--heartbeat-sec", "0",
+            "--data-path", str(shards),
+            "--results-dir", str(results),
+            "--checkpoint-dir", str(ckpt_dir), "--checkpoint-every", "4",
+            *extra,
+        ],
+        capture_output=True, text=True, env=_env(), timeout=300,
+    )
+
+
+@pytest.fixture(scope="module")
+def stream_sigkill_round_trip(tmp_path_factory):
+    """SIGKILL mid-stream at step 9, then resume on the same shards."""
+    base = tmp_path_factory.mktemp("stream_sigkill")
+    shards = base / "shards"
+    make_shards(str(shards), num_shards=4, records_per_shard=16, seq_len=32,
+                vocab_size=512)
+    results, ckpt_dir = base / "results", base / "ckpt"
+    p1 = _run_harness(results, ckpt_dir, shards,
+                      ("--inject-fault", "sigkill@9"))
+    p2 = _run_harness(results, ckpt_dir, shards, ("--resume",))
+    return {"base": base, "p1": p1, "p2": p2}
+
+
+def test_stream_sigkill_dies_with_stream_sidecars(stream_sigkill_round_trip):
+    rt = stream_sigkill_round_trip
+    assert rt["p1"].returncode != 0
+    ckpt = rt["base"] / "ckpt"
+    sidecars = sorted(f for f in os.listdir(ckpt) if f.startswith("stream_"))
+    assert sidecars, "no stream-state sidecars beside the checkpoints"
+    state = json.load(open(ckpt / sidecars[-1]))
+    step = int(sidecars[-1][len("stream_"):-len(".json")])
+    # 1 record/step at this geometry: cursor == records through the step.
+    assert state["cursor"] == step + 1
+
+
+def test_stream_resume_consumes_exactly_unconsumed_records(
+    stream_sigkill_round_trip,
+):
+    rt = stream_sigkill_round_trip
+    p2 = rt["p2"]
+    assert p2.returncode == 0, p2.stdout[-3000:] + p2.stderr[-2000:]
+    results = rt["base"] / "results"
+    row = json.load(open(results / f"result_{ARM}.json"))
+    assert row["data_mode"] == "stream"
+    assert row["resumed"] is True and row["n_restarts"] == 1
+    # Ledger-verified continuity: the resume started at exactly the
+    # sidecar cursor (1 record/step) and consumed every remaining record
+    # once — no replays, no skips across the stitch.
+    assert row["stream_cursor_start"] == row["resume_step"] + 1
+    assert row["stream_cursor_end"] == row["steps"]
+    assert row["records_consumed"] == row["steps"] - (row["resume_step"] + 1)
+    assert row["records_skipped"] == 0
+    failures = vr.validate_result(row, "stream-resumed-row")
+    failures += vr.validate_telemetry(
+        str(results / f"result_{ARM}.json"), row, "stream-resumed-row")
+    assert failures == [], failures
+
+
+@pytest.mark.slow
+def test_stream_data_stall_classifies_and_resumes(tmp_path):
+    """data-stall@N starves the loop -> exit 78 with reason=data_stall
+    (never the watchdog's hang), then the resume completes validated.
+    The chaos suite runs the same arm end-to-end with salvage."""
+    from distributed_llm_training_benchmark_framework_tpu.data import (
+        EXIT_DATA_STALL,
+    )
+
+    shards = tmp_path / "shards"
+    make_shards(str(shards), num_shards=4, records_per_shard=16, seq_len=32,
+                vocab_size=512)
+    results, ckpt_dir = tmp_path / "results", tmp_path / "ckpt"
+    p1 = _run_harness(results, ckpt_dir, shards,
+                      ("--inject-fault", "data-stall@9:600",
+                       "--data-stall-timeout-sec", "3"))
+    assert p1.returncode == EXIT_DATA_STALL, p1.stdout[-3000:]
+    from distributed_llm_training_benchmark_framework_tpu.telemetry import (
+        read_events,
+    )
+
+    events = read_events(str(results / f"telemetry_{ARM}.jsonl"))
+    aborted = [e for e in events if e["event"] == "run_aborted"]
+    assert aborted and aborted[0]["reason"] == "data_stall"
+    assert any(e["event"] == "data_stall" and e.get("fatal")
+               for e in events)
+    p2 = _run_harness(results, ckpt_dir, shards, ("--resume",))
+    assert p2.returncode == 0, p2.stdout[-3000:]
+    row = json.load(open(results / f"result_{ARM}.json"))
+    assert row["resumed"] is True
+    assert vr.validate_result(row, "stall-resumed-row") == []
