@@ -131,6 +131,7 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
             dropout=args.dropout if dropout == "inherit" else dropout,
             sync_every=args.sync_every,
             layer_loop=layer_loop,
+            tp_collective_matmul=args.tp_collective_matmul,
             checkpoint_dir=args.checkpoint_dir if use_checkpoint else None,
             checkpoint_every=args.checkpoint_every if use_checkpoint else 0,
             checkpoint_async=args.checkpoint_async and use_checkpoint,
@@ -149,6 +150,13 @@ def _measure_row(args, world, *, model_family, per_device_batch, grad_accum,
         # against unflagged history. Default runs keep the contract row
         # byte-identical (empty fingerprint -> key omitted -> "" lineage).
         row_extra["xla_scheduler_flags"] = result.xla_scheduler_flags
+    if result.tp_collective_matmul:
+        # Collective-matmul provenance (additive, only when the fusion is
+        # live): store.config_key reads it off the row, so a
+        # --tp-collective-matmul run forms its own regress lineage instead
+        # of cross-gating against the plain-tp history. Default runs keep
+        # the contract row byte-identical (key omitted -> plain lineage).
+        row_extra["tp_collective_matmul"] = True
     if result.comms_exposed_frac is not None:
         # Step-anatomy secondaries (additive, only when the arm profiled):
         # these ride into the registry record's result row, where the gate
@@ -320,6 +328,15 @@ def build_parser():
     p.add_argument("--xla-latency-hiding", action="store_true",
                    help="turn on XLA's latency-hiding scheduler + async "
                         "collective fusion for this invocation")
+    # Overlap round 3 (docs/PERFORMANCE.md §20): run the tp projections as
+    # ppermute-ring collective matmuls (ops/collective_matmul.py). Inert
+    # without tensor parallelism; recorded on the row and in the regress
+    # lineage key so cmm and plain runs never cross-gate.
+    p.add_argument("--tp-collective-matmul", action="store_true",
+                   help="decompose the tensor-parallel projection comms "
+                        "into ppermute rings that overlap the matmuls "
+                        "(collective matmul; needs a >1 'model' mesh axis "
+                        "to have any effect)")
     # Remat/HBM frontier sweep: re-run the flagship arm once per remat
     # policy and report tokens/sec vs peak-HBM per policy (additive
     # "remat_sweep" sub-object; one registry record per policy, the

@@ -65,6 +65,10 @@ def _partial_row(p: dict) -> dict:
             # the partial row (store.config_key reads data_mode — a dead
             # stream arm must not be misfiled into the synthetic lineage).
             "data_mode", "data_stall_frac", "records_skipped",
+            # Collective-matmul identity (round 15): keeps a dead cmm
+            # arm's partial row distinct from its plain-tp A/B partner
+            # and in the cmm regress lineage.
+            "tp_collective_matmul",
         ) if k in p
     }
     if "total_steps" in p:
@@ -145,7 +149,7 @@ def load_results(results_dir: str) -> pd.DataFrame:
             "pipeline_schedule", "virtual_stages", "expert_parallel",
             "n_experts", "remat_policy", "param_dtype", "offload_opt_state",
             "offload_delayed_update", "offload_dpu_start_step", "causal",
-            "ring_zigzag",
+            "ring_zigzag", "tp_collective_matmul",
             # Stitched-run identity (scaling suite): a reshard-on-restore
             # continuation shares every config axis with the fresh point
             # at the same geometry — without these, one of the two honest
@@ -173,7 +177,7 @@ def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
             "pipeline_schedule", "virtual_stages", "expert_parallel",
             "n_experts", "param_dtype", "offload_opt_state",
             "offload_delayed_update", "offload_dpu_start_step", "causal",
-            "ring_zigzag",
+            "ring_zigzag", "tp_collective_matmul",
         )
         if c in df.columns
     ]

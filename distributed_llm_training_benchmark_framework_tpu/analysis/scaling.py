@@ -80,6 +80,7 @@ LINEAGE_KEYS = (
     "pipeline_parallel", "pipeline_schedule", "expert_parallel",
     "n_experts", "param_dtype", "causal", "ring_zigzag",
     "steps", "warmup_steps", "remat_policy", "xla_scheduler_flags",
+    "tp_collective_matmul",
 )
 
 #: Axes that vary along a curve: the mesh size and the per-device work.

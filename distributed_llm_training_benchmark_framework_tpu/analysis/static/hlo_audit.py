@@ -40,7 +40,10 @@ COLLECTIVE_OPS = (
     "all-to-all",
 )
 
-_INJECTIONS = ("bad-kv-spec", "bad-fsdp-axis", "bad-pipeline-spec")
+_INJECTIONS = (
+    "bad-kv-spec", "bad-fsdp-axis", "bad-pipeline-spec",
+    "bad-forward-gather", "bad-cmm-ring",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,6 +155,22 @@ ROSTER: Dict[str, ArmSpec] = {
             ("data", "seq", "model"),
             global_batch=8, model_family="llama",
         ),
+        # llama x tp with the collective-matmul fusion (round 15,
+        # ops/collective_matmul.py): the gqa arm's shape with
+        # --tp-collective-matmul on. Its frozen budget IS the fusion's
+        # signature — the plain arm's 21 projection all-gathers collapse
+        # to the 5 embed/head-boundary gathers outside the layer stack,
+        # replaced by the ppermute ring (2 hops per projection class per
+        # layer, fwd+bwd), reshard suspects 0 (ring permutes are the
+        # budgeted schedule — audit_arm knows cmm arms permute
+        # legitimately). `--inject bad-cmm-ring` reverts the ring to the
+        # unfused all-gather/reduce-scatter lowering and the audit must
+        # flag the arm by name.
+        ArmSpec(
+            "llama-tp2-gqa-cmm", "ddp", (1, 1, 2), ("data", "seq", "model"),
+            global_batch=2, model_family="llama",
+            config_overrides=(("tp_collective_matmul", True),),
+        ),
         # Sequence parallel: the ring's collective-permute hops are the
         # budgeted schedule, not a regression.
         ArmSpec(
@@ -247,6 +266,10 @@ def lower_arm(spec: ArmSpec, devices=None):
         return _with_bad_fsdp_axis(compile_)
     if spec.inject == "bad-pipeline-spec":
         return _with_bad_pipeline_spec(compile_)
+    if spec.inject == "bad-forward-gather":
+        return _with_bad_forward_gather(compile_)
+    if spec.inject == "bad-cmm-ring":
+        return _with_bad_cmm_ring(compile_)
     return compile_()
 
 
@@ -265,8 +288,9 @@ def _with_bad_kv_spec(fn):
 
     real = strat.param_partition_specs
 
-    def misaligned(params, mesh, shard, kv_heads=None):
-        return real(params, mesh, shard=shard, kv_heads=None)
+    def misaligned(params, mesh, shard, kv_heads=None, scan_stacked=False):
+        return real(params, mesh, shard=shard, kv_heads=None,
+                    scan_stacked=scan_stacked)
 
     strat.param_partition_specs = misaligned
     try:
@@ -293,6 +317,45 @@ def _with_bad_fsdp_axis(fn):
         return fn()
     finally:
         strat._COMPOSED_FSDP_HYGIENE = True
+
+
+def _with_bad_forward_gather(fn):
+    """Run ``fn`` with the round-15 forward-side per-block param placement
+    reverted.
+
+    ``train.step._FORWARD_GATHER_OVERLAP = False`` makes
+    ``fsdp_block_param_spec`` return None, so the sharded-param arms'
+    weight slices lose their in-loop placement pins — the scanned
+    fsdp/zero3 lowerings regrow the full-stack activation gather (+1
+    all-gather, +1 all-to-all per arm on this jaxlib) the constraint
+    removed, and the audit must name the arms and the deltas.
+    """
+    from ...train import step as step_mod
+
+    step_mod._FORWARD_GATHER_OVERLAP = False
+    try:
+        return fn()
+    finally:
+        step_mod._FORWARD_GATHER_OVERLAP = True
+
+
+def _with_bad_cmm_ring(fn):
+    """Run ``fn`` with the collective-matmul ppermute decomposition broken.
+
+    ``ops.collective_matmul._CMM_RING = False`` reverts the ring bodies to
+    their unfused all_gather / psum_scatter forms — mathematically equal,
+    structurally the bulk collectives the fusion exists to remove. The
+    llama-tp2-gqa-cmm frozen budget (projection all-gathers gone, ring
+    permutes in their place) must flag the arm by name with the
+    all-gather/reduce-scatter growth and the vanished permutes.
+    """
+    from ...ops import collective_matmul as cm
+
+    cm._CMM_RING = False
+    try:
+        return fn()
+    finally:
+        cm._CMM_RING = True
 
 
 def _with_bad_pipeline_spec(fn):
@@ -365,7 +428,11 @@ def audit_arm(spec: ArmSpec, devices=None) -> ArmReport:
     collectives = count_collectives(txt)
     seq = dict(zip(spec.axes, spec.mesh_shape)).get("seq", 1)
     pipe = dict(zip(spec.axes, spec.mesh_shape)).get("pipe", 1)
-    permutes_legit = seq > 1 or pipe > 1
+    # Collective-matmul arms permute legitimately too: the ppermute ring
+    # IS the fusion's comms (the exact pin still catches drift — a real
+    # reshard fallback grows the frozen permute count by name).
+    cmm = bool(dict(spec.config_overrides).get("tp_collective_matmul"))
+    permutes_legit = seq > 1 or pipe > 1 or cmm
     return ArmReport(
         arm=spec.name,
         collectives=collectives,
@@ -1060,7 +1127,15 @@ TOPOLOGY_TIERS: Dict[str, TopologyTier] = {
 #: under the per-tier budgets: its pipe degree is identity, the data
 #: axis absorbs the tier, and its ring-permute count must stay CONSTANT
 #: as data grows (the growth laws' at-most-linear bound covers it).
-TOPOLOGY_ARMS = ("zero2-dp8", "fsdp-dp8", "llama-tp2-gqa", "pp2-gpipe")
+#: ``llama-tp2-gqa-cmm`` (round 15) rides the same contract for the
+#: collective-matmul ring: the ppermute count is a function of the tp
+#: degree alone (2 hops per projection class per layer at tp=2), so it
+#: must stay FLAT along the data axis — each tier's exact pin freezes
+#: it, and the at-most-linear law bounds any drift between tiers.
+TOPOLOGY_ARMS = (
+    "zero2-dp8", "fsdp-dp8", "llama-tp2-gqa", "pp2-gpipe",
+    "llama-tp2-gqa-cmm",
+)
 
 #: Tiers ``graftcheck --all`` audits by default. v5e-256 compiles in
 #: ~40s+ per arm on a small host — audit it explicitly with

@@ -176,6 +176,36 @@ class TinyGPTConfig:
     # (DeepSpeed ZeRO's bucketed overlap, GSPMD-native). A tuple (not a
     # dict) so the config stays hashable.
     block_grad_spec: Any = None
+    # FSDP/ZeRO-3 per-block parameter placement (round 15) — the forward-side
+    # dual of block_grad_spec: a sorted tuple of (block leaf name,
+    # PartitionSpec-for-one-layer-slice) pairs, set by the train step for
+    # sharded-param strategies (train/step.py::fsdp_block_param_spec). When
+    # present, apply_blocks pins each layer's weight SLICE to its sharded
+    # placement INSIDE the forward layer loop — so the weight all-gather the
+    # matmul needs issues per block, right before that block's dots, instead
+    # of being free to bundle ahead of the whole layer stack (the structure
+    # XLA's latency-hiding scheduler needs to overlap weight gathers with
+    # adjacent blocks' forward compute; FSDP's prefetch-one-block schedule,
+    # GSPMD-native). Transposes to the same per-block constraint on the
+    # cotangent — exactly the fsdp/zero3 per-block grad placement.
+    block_param_spec: Any = None
+    # Scan-carry activation placement (round 15): a PartitionSpec for the
+    # (B, S, D) residual stream carried through the layer scan, set by the
+    # train step (train/step.py::scan_carry_spec) for scanned sharded-param
+    # arms on composed dp x tp meshes. Without it XLA picks its own layout
+    # for the scan's stacked activation stash and reconciles per iteration
+    # with collective-permute chains (the banked llama-fsdp-dp4-tp2-scan
+    # replication-reshard residue); pinning the carry at the body boundary
+    # pins the stash layout with it.
+    scan_carry_spec: Any = None
+    # Collective-matmul tp fusion (round 15, ops/collective_matmul.py): when
+    # True and a >1 'model' mesh axis is in scope, the tp projections
+    # (attention qkv/out, MLP up/down) run as shard_map-decomposed matmuls —
+    # the activation all-gather/reduce-scatter split into per-shard chunks
+    # rotated by ppermute so the comms hide INSIDE the dot, and the residual
+    # stream between projections rides sequence-sharded over 'model'
+    # (Megatron sequence-parallel layout). Opt-in via --tp-collective-matmul.
+    tp_collective_matmul: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -550,16 +580,45 @@ def _wcs_bwd(spec, _res, g):
 _with_cotangent_spec.defvjp(_wcs_fwd, _wcs_bwd)
 
 
-def _constrain_layer_grads(config: TinyGPTConfig, layer: Params) -> Params:
-    """Apply ``config.block_grad_spec`` to one layer's weight slice (no-op
-    when unset). Leaves without a spec entry pass through untouched."""
-    if not config.block_grad_spec:
+def _apply_leaf_specs(layer: Params, spec_table: Any, wrap) -> Params:
+    """Apply a (leaf name, spec) table to one layer's weight slice via
+    ``wrap(spec, leaf)`` — leaves without an entry pass through untouched;
+    an unset table is an exact no-op. The one iteration both per-block
+    placement hooks share."""
+    if not spec_table:
         return layer
-    specs = dict(config.block_grad_spec)
+    specs = dict(spec_table)
     return {
-        k: (_with_cotangent_spec(specs[k], v) if k in specs else v)
+        k: (wrap(specs[k], v) if k in specs else v)
         for k, v in layer.items()
     }
+
+
+def _constrain_layer_grads(config: TinyGPTConfig, layer: Params) -> Params:
+    """Apply ``config.block_grad_spec`` to one layer's weight slice: the
+    COTANGENT constraint (zero2 per-block grad placement)."""
+    return _apply_leaf_specs(layer, config.block_grad_spec, _with_cotangent_spec)
+
+
+def _constrain_layer_params(config: TinyGPTConfig, layer: Params) -> Params:
+    """Apply ``config.block_param_spec`` to one layer's weight slice: a
+    PRIMAL sharding constraint pinning the slice to its sharded
+    (fsdp/zero3) placement at the point of use, so the all-gather the
+    block's matmuls need issues inside the layer loop instead of bundling
+    ahead of the stack. The constraint's transpose places the cotangent
+    identically — the per-block grad layout for free."""
+    return _apply_leaf_specs(
+        layer, config.block_param_spec,
+        lambda spec, v: lax.with_sharding_constraint(v, spec),
+    )
+
+
+def _constrain_layer(config: TinyGPTConfig, layer: Params) -> Params:
+    """Both per-block placement hooks, primal (block_param_spec) inside the
+    cotangent wrap (block_grad_spec) — strategies arm at most one today."""
+    return _constrain_layer_grads(
+        config, _constrain_layer_params(config, layer)
+    )
 
 
 def _block(
@@ -585,23 +644,56 @@ def _block(
         # keys[0] stays shared — ring/Ulysses handle their own coordinates.
         keys = (keys[0], jax.random.fold_in(keys[1], lax.axis_index(c.seq_manual_axis)))
 
+    # Collective-matmul tp fusion (round 15, ops/collective_matmul.py):
+    # route the four projection classes through the ppermute-ring
+    # decomposition — the residual stream between them rides
+    # sequence-sharded over 'model', and the activation all-gather /
+    # partial-sum reduce-scatter hide inside the dots. The helpers fall
+    # back to the plain einsum when no >1 'model' axis is in scope, so
+    # the knob is inert on pure-dp meshes. Incompatible with the
+    # pipeline schedules' manual sequence region (the stream is already
+    # manual over 'seq' there) — refused loudly rather than silently
+    # computing a doubly-sharded projection.
+    use_cmm = c.tp_collective_matmul
+    if use_cmm and c.seq_manual_axis is not None:
+        raise ValueError(
+            "tp_collective_matmul cannot run inside a sequence-manual "
+            "pipeline region (the residual stream is already sharded "
+            "over the manual 'seq' axis; drop --tp-collective-matmul "
+            "for pipeline arms)"
+        )
+    if use_cmm:
+        from ..ops import collective_matmul as _cm
+
     # --- attention sublayer ---
     h = _norm(c, x, layer["ln1_scale"], layer.get("ln1_bias"))
     if "wqkv" in layer:  # fused MHA projection (kv_heads == n_head)
-        qkv = jnp.einsum(
-            "bsd,dce->bsce", h, layer["wqkv"].astype(cd), preferred_element_type=jnp.float32
-        ).astype(cd)
+        if use_cmm:
+            qkv = _cm.ag_proj(h, layer["wqkv"].astype(cd)).astype(cd)
+        else:
+            qkv = jnp.einsum(
+                "bsd,dce->bsce", h, layer["wqkv"].astype(cd), preferred_element_type=jnp.float32
+            ).astype(cd)
         if "bqkv" in layer:
             qkv = qkv + layer["bqkv"].astype(cd)
         to_heads = lambda t: t.reshape(B, S, c.n_head, c.head_dim)
         q, k, v = (to_heads(qkv[:, :, i]) for i in range(3))
     else:  # GQA: separate q and stacked k/v projections
-        q = jnp.einsum(
-            "bsd,de->bse", h, layer["wq"].astype(cd), preferred_element_type=jnp.float32
-        ).astype(cd)
-        kv = jnp.einsum(
-            "bsd,dce->bsce", h, layer["wkv"].astype(cd), preferred_element_type=jnp.float32
-        ).astype(cd)
+        if use_cmm:
+            q = _cm.ag_proj(h, layer["wq"].astype(cd)).astype(cd)
+            # kv rides the kv-head-aligned rule (aligned_units): with a
+            # misaligned 'model' degree the weight enters replicated and
+            # the ring produces replicated full-kv outputs.
+            kv = _cm.ag_proj(
+                h, layer["wkv"].astype(cd), aligned_units=c.kv_heads
+            ).astype(cd)
+        else:
+            q = jnp.einsum(
+                "bsd,de->bse", h, layer["wq"].astype(cd), preferred_element_type=jnp.float32
+            ).astype(cd)
+            kv = jnp.einsum(
+                "bsd,dce->bsce", h, layer["wkv"].astype(cd), preferred_element_type=jnp.float32
+            ).astype(cd)
         if "bq" in layer:
             q = q + layer["bq"].astype(cd)
             kv = kv + layer["bkv"].astype(cd)
@@ -631,9 +723,12 @@ def _block(
         v = jnp.repeat(v, rep, axis=2)
     attn = _attention(c, q, k, v, keys[0], deterministic)
     attn = attn.reshape(B, S, D)
-    attn = jnp.einsum(
-        "bsd,de->bse", attn, layer["wo"].astype(cd), preferred_element_type=jnp.float32
-    ).astype(cd)
+    if use_cmm:
+        attn = _cm.rs_proj(attn, layer["wo"].astype(cd)).astype(cd)
+    else:
+        attn = jnp.einsum(
+            "bsd,de->bse", attn, layer["wo"].astype(cd), preferred_element_type=jnp.float32
+        ).astype(cd)
     if "bo" in layer:
         attn = attn + layer["bo"].astype(cd)
     x = x + attn
@@ -647,22 +742,31 @@ def _block(
         h, aux = moe_mlp(c, layer, h, keys[1], deterministic)
         return x + h, aux
     if c.mlp_act == "swiglu":
-        gu = jnp.einsum(
-            "bsd,dcf->bscf", h, layer["wgu"].astype(cd), preferred_element_type=jnp.float32
-        ).astype(cd)
+        if use_cmm:
+            gu = _cm.ag_proj(h, layer["wgu"].astype(cd)).astype(cd)
+        else:
+            gu = jnp.einsum(
+                "bsd,dcf->bscf", h, layer["wgu"].astype(cd), preferred_element_type=jnp.float32
+            ).astype(cd)
         if "bgu" in layer:
             gu = gu + layer["bgu"].astype(cd)
         h = jax.nn.silu(gu[:, :, 0]) * gu[:, :, 1]
     else:
-        h = jnp.einsum(
-            "bsd,df->bsf", h, layer["wfc"].astype(cd), preferred_element_type=jnp.float32
-        ).astype(cd)
+        if use_cmm:
+            h = _cm.ag_proj(h, layer["wfc"].astype(cd)).astype(cd)
+        else:
+            h = jnp.einsum(
+                "bsd,df->bsf", h, layer["wfc"].astype(cd), preferred_element_type=jnp.float32
+            ).astype(cd)
         if "bfc" in layer:
             h = h + layer["bfc"].astype(cd)
         h = jax.nn.gelu(h, approximate=False)  # torch nn.GELU default is exact erf
-    h = jnp.einsum(
-        "bsf,fd->bsd", h, layer["wproj"].astype(cd), preferred_element_type=jnp.float32
-    ).astype(cd)
+    if use_cmm:
+        h = _cm.rs_proj(h, layer["wproj"].astype(cd)).astype(cd)
+    else:
+        h = jnp.einsum(
+            "bsf,fd->bsd", h, layer["wproj"].astype(cd), preferred_element_type=jnp.float32
+        ).astype(cd)
     if "bproj" in layer:
         h = h + layer["bproj"].astype(cd)
     h = _dropout(h, c.dropout, keys[1], deterministic)
@@ -754,14 +858,24 @@ def apply_blocks(
             ki = (
                 jax.random.fold_in(base_key, layer_offset + i) if live else None
             )
-            x, a = block(x, _constrain_layer_grads(c, layer), ki)
+            x, a = block(x, _constrain_layer(c, layer), ki)
             aux = aux + a
         return x, aux
+
+    def _pin_carry(x):
+        # Scan-carry placement (round 15): pinning the residual stream at
+        # the body boundary pins the backward's stacked activation-stash
+        # layout with it — without this XLA picks a stash layout of its own
+        # and reconciles per iteration with collective-permute chains (the
+        # banked llama-fsdp-dp4-tp2-scan reshard residue).
+        if c.scan_carry_spec is None:
+            return x
+        return lax.with_sharding_constraint(x, c.scan_carry_spec)
 
     if base_key is None or deterministic:
         def scan_body(carry, layer):
             x, aux = carry
-            x, a = block(x, _constrain_layer_grads(c, layer), None)
+            x, a = block(_pin_carry(x), _constrain_layer(c, layer), None)
             return (x, aux + a), None
 
         (x, aux), _ = lax.scan(scan_body, (x, _aux0()), blocks)
@@ -772,7 +886,7 @@ def apply_blocks(
         def scan_body(carry, li):
             x, aux = carry
             x, a = block(
-                x, _constrain_layer_grads(c, li[0]),
+                _pin_carry(x), _constrain_layer(c, li[0]),
                 jax.random.fold_in(base_key, li[1]),
             )
             return (x, aux + a), None

@@ -627,6 +627,24 @@ _COMPOSED_FSDP_HYGIENE = True
 #: a 'model' axis the gathers never risk the transposed-order permutes).
 _COMPOSED_MIN_SHARD_ELEMENTS = 4096
 
+#: Round-15 scan-carry kill: stacked column-parallel leaves whose ONLY
+#: hygiene-legal 'data' axis is the embed (contraction) axis stay
+#: model-only sharded in composed dp x tp meshes — under the SCANNED layer
+#: loop only (``param_partition_specs(scan_stacked=True)``); the unrolled
+#: lowering has no stacked stash and keeps the round-8 placement, so the
+#: suite's measured llama-fsdp-dp4-tp2 budget stays byte-identical.
+#: Data-sharding the
+#: contraction dim makes GSPMD lower the projection as contraction-partial
+#: matmuls whose scanned activation/grad stash reshards between tilings
+#: with collective-permute chains — measured on llama-fsdp-dp4-tp2-scan,
+#: where 'blocks/wq' was the source of the banked 4 reshard suspects
+#: (together with the scan-carry pin, 4 -> 0). Scoped to the measured
+#: leaf: wkv/wgu data-shard the same axis without tripping the stash
+#: (and wgu is the largest block leaf — its fsdp split is the memory win
+#: worth keeping); the unexercised tinygpt siblings (wqkv/wfc) keep the
+#: old placement until a composed-mesh tinygpt arm joins the roster.
+_COMPOSED_CONTRACTION_DATA_SKIP = frozenset({"blocks/wq"})
+
 
 def _shard_largest_free_axis(
     spec: list, shape: Tuple[int, ...], n_shards: int, is_block_leaf: bool,
@@ -680,7 +698,8 @@ def _shard_largest_free_axis(
 
 
 def param_partition_specs(
-    params: Params, mesh: Mesh, shard: bool, kv_heads: Optional[int] = None
+    params: Params, mesh: Mesh, shard: bool, kv_heads: Optional[int] = None,
+    scan_stacked: bool = False,
 ) -> Params:
     """PartitionSpec pytree for the params under a given strategy + mesh.
 
@@ -708,6 +727,14 @@ def param_partition_specs(
     after a leaf's 'model' axis (the transposed tile order is the
     llama-fsdp-dp4-tp2 collective-permute fallback) and vector-like leaves
     stay replicated over 'data'.
+
+    ``scan_stacked`` (round 15) says the caller compiles the SCANNED layer
+    loop: composed meshes then keep the
+    :data:`_COMPOSED_CONTRACTION_DATA_SKIP` leaves model-only — the scan's
+    stacked activation/grad stash is what reshards with permute chains
+    when those leaves data-shard their contraction axis. The unrolled
+    lowering has no stacked stash and keeps the round-8 placement (its
+    frozen budgets stay byte-identical).
     """
     n_data = mesh.shape.get("data", 1)
     n_model = mesh.shape.get("model", 1)
@@ -746,9 +773,21 @@ def param_partition_specs(
                 if s[ax] is None and leaf.shape[ax] % n_model == 0:
                     s[ax] = "model"
         if shard and n_data > 1:
-            _shard_largest_free_axis(
-                s, leaf.shape, n_data, is_block, composed=n_model > 1
-            )
+            if (
+                scan_stacked
+                and n_model > 1
+                and _COMPOSED_FSDP_HYGIENE
+                and name in _COMPOSED_CONTRACTION_DATA_SKIP
+            ):
+                # Round-15 scan-carry rule: keep the leaf model-only (see
+                # _COMPOSED_CONTRACTION_DATA_SKIP) — the same posture the
+                # hygiene rules already give the row-parallel leaves, whose
+                # leading 'model' axis leaves no legal 'data' slot either.
+                pass
+            else:
+                _shard_largest_free_axis(
+                    s, leaf.shape, n_data, is_block, composed=n_model > 1
+                )
         return P(*s)
 
     return jax.tree_util.tree_map_with_path(spec, params)
@@ -761,6 +800,7 @@ def opt_state_partition_specs(
     mesh: Mesh,
     shard: bool,
     kv_heads: Optional[int] = None,
+    scan_stacked: bool = False,
 ) -> Any:
     """PartitionSpec pytree for the optimizer state.
 
@@ -772,7 +812,8 @@ def opt_state_partition_specs(
     state_shapes = jax.eval_shape(optimizer.init, params)
     if shard:
         moment_specs = param_partition_specs(
-            params, mesh, shard=True, kv_heads=kv_heads
+            params, mesh, shard=True, kv_heads=kv_heads,
+            scan_stacked=scan_stacked,
         )
     else:
         moment_specs = param_specs

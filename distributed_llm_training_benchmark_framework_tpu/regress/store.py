@@ -177,6 +177,9 @@ def env_fingerprint(result_row: Optional[Dict[str, Any]] = None) -> Dict[str, An
     # collectives — utils.platform.scheduler_flags_fingerprint): an env
     # change that moves the collective schedule must be visible in triage.
     fp["xla_scheduler_flags"] = r.get("xla_scheduler_flags") or ""
+    # Collective-matmul tp fusion (round 15): a structurally different
+    # projection schedule — triage must see it beside the scheduler flags.
+    fp["tp_collective_matmul"] = bool(r.get("tp_collective_matmul"))
     fp["mesh"] = {
         "world_size": r.get("world_size"),
         "tensor_parallel": r.get("tensor_parallel", 1),
@@ -222,6 +225,11 @@ def config_key(record: Dict[str, Any]) -> Tuple:
         # Remat policy trades HBM for recompute: every --remat-sweep
         # point is its own lineage (absent on legacy rows -> None).
         r.get("remat_policy"),
+        # The collective-matmul tp fusion replaces the projection
+        # collectives with a ppermute ring — a different collective
+        # schedule, so cmm and plain-tp runs are separate lineages
+        # (legacy rows carry no field -> False -> the plain lineage).
+        bool(r.get("tp_collective_matmul")),
         # Input path is methodology: a streaming (--data-path) run pays
         # host-read + device-put costs the synthetic table never does, so
         # it must not gate against (or feed the noise floor of) the

@@ -134,6 +134,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prng-impl", choices=["rbg", "threefry"], default="rbg",
                    help="Dropout-key PRNG: rbg (fast, default) or threefry "
                         "(bit-reproducible across backends)")
+    p.add_argument("--tp-collective-matmul", action="store_true",
+                   help="Overlap round 3 (ops/collective_matmul.py): run "
+                        "the tensor-parallel projections as shard_map "
+                        "collective matmuls — the activation all-gather/"
+                        "reduce-scatter decomposed into ppermute ring hops "
+                        "that hide inside the dots, with the residual "
+                        "stream sequence-sharded over 'model'. Inert "
+                        "without a >1 tensor-parallel axis; refuses "
+                        "pipeline/sequence-parallel/MoE compositions. "
+                        "Joins the result row and the regress lineage key "
+                        "so cmm and plain runs never cross-gate")
     p.add_argument("--layer-loop", choices=["scan", "unrolled"], default="scan",
                    help="Transformer layer iteration: lax.scan over stacked "
                         "weights (fast compile) or an unrolled loop (~15%% "
@@ -417,6 +428,7 @@ def main(argv=None) -> int:
                 else None
             ),
             layer_loop=args.layer_loop,
+            tp_collective_matmul=args.tp_collective_matmul,
             offload_dpu_start_step=args.offload_dpu_start_step,
             prng_impl=args.prng_impl,
             dataset_size=args.dataset_size,

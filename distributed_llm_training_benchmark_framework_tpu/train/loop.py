@@ -207,6 +207,14 @@ def _make_recorder(kwargs: dict) -> TelemetryRecorder:
             # Synthetic runs omit the key so their heartbeat/telemetry
             # bytes stay unchanged.
             meta["data_mode"] = "stream"
+        if kwargs.get("tp_collective_matmul"):
+            # Collective-matmul identity (round 15), same posture as
+            # data_mode: a dead cmm arm's salvaged partial row must stay
+            # distinct from its llama-tp2-ddp A/B partner in the metrics
+            # dedup AND land in the cmm regress lineage (store.config_key
+            # reads the field off the row). Plain runs omit the key so
+            # their heartbeat bytes stay unchanged.
+            meta["tp_collective_matmul"] = True
         rec = TelemetryRecorder(
             arm,
             results_dir=kwargs.get("results_dir"),
@@ -323,6 +331,7 @@ def _run_benchmark_impl(
     flash_block_k_bwd: Optional[int] = None,
     flash_pallas_backward: Optional[bool] = None,
     layer_loop: str = "scan",
+    tp_collective_matmul: bool = False,
     offload_dpu_start_step: int = 0,
     dataset_size: int = 1000,
     log_every: int = 10,
@@ -516,6 +525,32 @@ def _run_benchmark_impl(
         overrides["ring_zigzag"] = ring_zigzag
     if n_experts > 0:
         overrides["n_experts"] = n_experts
+    if tp_collective_matmul:
+        # Collective-matmul tp fusion (round 15, ops/collective_matmul.py):
+        # the residual stream rides sequence-sharded over 'model' between
+        # ppermute-ring projections. Compositions that already own the
+        # sequence layout are refused loudly rather than silently
+        # double-sharding: pipeline schedules run the stream manually over
+        # 'seq', sequence-parallel attention shards S over 'seq', and the
+        # MoE dispatch owns the token layout through the expert all-to-all.
+        if pp > 1:
+            raise ValueError(
+                "--tp-collective-matmul cannot compose with pipeline "
+                "parallelism (the pipeline runs the residual stream "
+                "manually over 'seq'; drop one of the two)"
+            )
+        if sp > 1:
+            raise ValueError(
+                "--tp-collective-matmul cannot compose with sequence "
+                "parallelism (both want to own the sequence axis; the "
+                "ring/ulysses arms already overlap their comms)"
+            )
+        if n_experts > 0:
+            raise ValueError(
+                "--tp-collective-matmul does not support MoE models (the "
+                "expert dispatch owns the token layout; dense MLPs only)"
+            )
+        overrides["tp_collective_matmul"] = True
     if flash_block_q is not None:
         overrides["flash_block_q"] = flash_block_q
     if flash_block_k is not None:
@@ -1774,6 +1809,7 @@ def _run_benchmark_impl(
             "auto" if model_config.ring_zigzag is None
             else "on" if model_config.ring_zigzag else "off"
         ),
+        tp_collective_matmul=model_config.tp_collective_matmul,
         expert_overflow_pct=expert_overflow_pct,
         model_family=model_family,
         resumed=resume_step >= 0,

@@ -101,6 +101,103 @@ def _resolve_model_config(
 
 
 
+#: Self-test escape hatch (graftcheck `--inject bad-forward-gather`): False
+#: reverts the round-15 forward-side per-block param placement, letting the
+#: sharded-param arms' weight all-gathers float free of the layer loop again
+#: so CI can prove the HLO auditor catches the regression.
+_FORWARD_GATHER_OVERLAP = True
+
+
+def _per_block_slice_specs(stacked_specs: Params):
+    """(leaf name, layer-slice PartitionSpec) pairs for one block table.
+
+    Shared by the zero2 grad rule and the fsdp/zero3 param rule: dropping
+    the leading entry of each stacked spec is exactly the layer-slice
+    layout (the stack axis disappears). Leaves whose shard landed on the
+    stacked LAYERS axis (spec[0] non-None — the chooser's fallback when no
+    in-layer axis divides) are skipped: their per-layer slice is genuinely
+    replicated, and pinning it mid-loop would add a per-layer round-trip
+    instead of hiding one. Returns None when nothing is armable.
+    """
+    per_block = tuple(sorted(
+        (name, P(*list(spec)[1:]))
+        for name, spec in stacked_specs["blocks"].items()
+        if list(spec)[0] is None
+    ))
+    return per_block or None
+
+
+def fsdp_block_param_spec(
+    strategy: strat.StrategyConfig,
+    param_specs: Params,
+    pipelined: bool,
+):
+    """The per-layer-slice PARAM placement for the fsdp/zero3 forward-overlap
+    path — the forward-side dual of :func:`zero2_block_grad_spec`.
+
+    Handing the model this spec table (``TinyGPTConfig.block_param_spec``)
+    pins each block's weight slice to its sharded placement INSIDE the
+    forward layer loop (``tinygpt._constrain_layer_params``), so the weight
+    all-gather each block's matmuls need issues per block right before those
+    dots — instead of being free to bundle ahead of the whole layer stack,
+    where nothing anchors it and the scheduler serializes it against the
+    first layer. That per-block anchoring is what XLA's latency-hiding
+    scheduler needs to overlap block i+1's gather with block i's compute
+    (FSDP's prefetch-one-block schedule, GSPMD-native). The constraint
+    transposes onto the cotangent, which for fsdp/zero3 is exactly the
+    per-block grad placement — both halves of the frontier from one wrap.
+
+    None for every other shape: ddp/zero2 params are replicated (nothing to
+    gather), and pipeline schedules run inside a partially-manual shard_map
+    where GSPMD constraints don't apply. Leaves whose shard landed on the
+    stacked LAYERS axis (spec[0] non-None — the chooser's fallback when no
+    in-layer axis divides) are skipped: their per-layer slice is genuinely
+    replicated, and pinning it would add a per-layer round-trip. Composed
+    dp x tp meshes arm too — the slice spec keeps both axes.
+    """
+    if not _FORWARD_GATHER_OVERLAP:
+        return None
+    if not (strategy.shard_params and not pipelined):
+        return None
+    return _per_block_slice_specs(param_specs)
+
+
+def scan_carry_spec(
+    strategy: strat.StrategyConfig,
+    mesh: Mesh,
+    cfg: tinygpt.TinyGPTConfig,
+    pipelined: bool,
+):
+    """The residual-stream placement pinned through the layer scan, or None.
+
+    Armed exactly for SHARDED-PARAM (fsdp/zero3), scanned, non-pipelined
+    arms on composed dp x tp meshes: there XLA otherwise picks its own
+    layout for the scan's stacked activation stash — measured on
+    llama-fsdp-dp4-tp2-scan as a batch-replicated,
+    embed-sharded-over-'data' stash whose backward reconciles against the
+    batch-sharded compute layout with collective-permute chains (the
+    banked reshard residue). Pinning the (B, S, D) carry to the batch
+    layout at the body boundary pins the stash with it (together with the
+    _COMPOSED_CONTRACTION_DATA_SKIP spec rule: suspects 4 -> 0).
+    Replicated-param strategies cannot exhibit the pathology (no weight
+    leaf data-shards its contraction axis), so ddp/zero2 composed arms —
+    e.g. the llama-tp2-gqa topology clients — keep their frozen lowerings
+    byte-unchanged; so do pure-dp and single-axis meshes. The
+    collective-matmul path owns its own residual layout (sequence-sharded
+    over 'model') and is skipped.
+    """
+    if not strategy.shard_params:
+        return None
+    if not cfg.scan_layers or pipelined or cfg.tp_collective_matmul:
+        return None
+    if mesh.shape.get("data", 1) <= 1 or mesh.shape.get("model", 1) <= 1:
+        return None
+    batch = list(strat.batch_partition_spec(mesh))
+    while len(batch) < 2:
+        batch.append(None)
+    return P(batch[0], batch[1], None)
+
+
 def zero2_block_grad_spec(
     strategy: strat.StrategyConfig,
     grad_sharded_specs: Params,
@@ -130,12 +227,7 @@ def zero2_block_grad_spec(
     if not (strategy.shard_grads and not strategy.shard_params
             and not pipelined):
         return None
-    per_block = tuple(sorted(
-        (name, P(*list(spec)[1:]))
-        for name, spec in grad_sharded_specs["blocks"].items()
-        if list(spec)[0] is None
-    ))
-    return per_block or None
+    return _per_block_slice_specs(grad_sharded_specs)
 
 
 def pipeline_schedule_meta(
@@ -253,6 +345,7 @@ def make_train_step(
         mesh,
         shard=True,
         kv_heads=cfg.kv_heads,
+        scan_stacked=cfg.scan_layers,
     )
     batch_spec = strat.batch_partition_spec(mesh)
     # (accum, batch, seq): shard the *batch* dim, accum dim is sequential.
@@ -285,6 +378,12 @@ def make_train_step(
     block_spec = zero2_block_grad_spec(strategy, grad_sharded_specs, pipelined)
     if block_spec is not None:
         cfg = dataclasses.replace(cfg, block_grad_spec=block_spec)
+    pblock_spec = fsdp_block_param_spec(strategy, param_specs, pipelined)
+    if pblock_spec is not None:
+        cfg = dataclasses.replace(cfg, block_param_spec=pblock_spec)
+    carry_spec = scan_carry_spec(strategy, mesh, cfg, pipelined)
+    if carry_spec is not None:
+        cfg = dataclasses.replace(cfg, scan_carry_spec=carry_spec)
 
     def train_step(params, opt_state, batch, step):
         if from_table:
@@ -471,11 +570,13 @@ def abstract_compile_step(
         lambda key: tinygpt.init_params(cfg, key), jax.random.key(0)
     )
     param_specs = strat.param_partition_specs(
-        params_shape, mesh, shard=strategy.shard_params, kv_heads=cfg.kv_heads
+        params_shape, mesh, shard=strategy.shard_params, kv_heads=cfg.kv_heads,
+        scan_stacked=cfg.scan_layers,
     )
     opt_specs = strat.opt_state_partition_specs(
         optimizer, params_shape, param_specs, mesh,
         shard=strategy.shard_opt_state, kv_heads=cfg.kv_heads,
+        scan_stacked=cfg.scan_layers,
     )
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
 
@@ -624,11 +725,13 @@ def create_train_state(
 
     params_shape = jax.eval_shape(init_fn, jax.random.key(0))
     param_specs = strat.param_partition_specs(
-        params_shape, mesh, shard=strategy.shard_params, kv_heads=cfg.kv_heads
+        params_shape, mesh, shard=strategy.shard_params, kv_heads=cfg.kv_heads,
+        scan_stacked=cfg.scan_layers,
     )
     opt_specs = strat.opt_state_partition_specs(
         optimizer, params_shape, param_specs, mesh,
         shard=strategy.shard_opt_state, kv_heads=cfg.kv_heads,
+        scan_stacked=cfg.scan_layers,
     )
 
     if abstract_init:

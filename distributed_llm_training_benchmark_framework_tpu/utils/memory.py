@@ -132,19 +132,23 @@ def estimate_hbm(
     params_shape = jax.eval_shape(
         functools.partial(tinygpt.init_params, cfg), jax.random.key(0)
     )
+    scan_stacked = bool(getattr(cfg, "scan_layers", True))
     param_specs = strat.param_partition_specs(
-        params_shape, mesh, shard=strategy.shard_params, kv_heads=cfg.kv_heads
+        params_shape, mesh, shard=strategy.shard_params, kv_heads=cfg.kv_heads,
+        scan_stacked=scan_stacked,
     )
     grad_specs = strat.param_partition_specs(
         params_shape, mesh,
         shard=strategy.shard_params or strategy.shard_grads,
         kv_heads=cfg.kv_heads,
+        scan_stacked=scan_stacked,
     )
     optimizer = strat.make_optimizer(strategy)
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
     opt_specs = strat.opt_state_partition_specs(
         optimizer, params_shape, param_specs, mesh,
         shard=strategy.shard_opt_state, kv_heads=cfg.kv_heads,
+        scan_stacked=scan_stacked,
     )
 
     params_b = _sharded_bytes(params_shape, param_specs, mesh)

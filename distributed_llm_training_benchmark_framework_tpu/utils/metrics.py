@@ -299,6 +299,12 @@ class BenchmarkResult:
     # Ring-attention zigzag layout mode ('auto'/'on'/'off') — run identity
     # for the scaling-day zigzag A/B arms, which differ in nothing else.
     ring_zigzag: str = "auto"
+    # Collective-matmul tp fusion (round 15, ops/collective_matmul.py) —
+    # run identity: the ppermute-ring projection schedule is a different
+    # measurement than the plain tp lowering, so cmm and non-cmm runs
+    # must never cross-gate (store.config_key includes this field,
+    # mirroring xla_scheduler_flags).
+    tp_collective_matmul: bool = False
     # MoE runs: measured fraction (%) of (token, choice) expert assignments
     # dropped by the capacity limit on the trained params (models.tinygpt
     # .moe_overflow_fraction diagnostic); None for dense runs or when the
@@ -486,6 +492,7 @@ def compute_result(
     offload_dpu_start_step: int = 0,
     causal: bool = False,
     ring_zigzag: str = "auto",
+    tp_collective_matmul: bool = False,
     expert_overflow_pct: Optional[float] = None,
     model_family: str = "tinygpt",
     resumed: bool = False,
@@ -648,6 +655,7 @@ def compute_result(
         offload_dpu_start_step=offload_dpu_start_step,
         causal=causal,
         ring_zigzag=ring_zigzag,
+        tp_collective_matmul=tp_collective_matmul,
         expert_overflow_pct=expert_overflow_pct,
         model_family=model_family,
         loss_first_window=loss_first,

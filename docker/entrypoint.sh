@@ -131,6 +131,11 @@ export HEARTBEAT_SEC="${HEARTBEAT_SEC:-}"
 # regress lineage, so flagged pods never cross-gate against unflagged
 # history.
 export XLA_LATENCY_HIDING="${XLA_LATENCY_HIDING:-0}"
+# Overlap round 3 (docs/PERFORMANCE.md §20): 1 = run the tensor-parallel
+# projections as collective matmuls (ppermute-ring decomposed comms,
+# ops/collective_matmul.py). Joins the result row + regress lineage key,
+# so cmm pods never cross-gate against plain-tp history.
+export TP_COLLECTIVE_MATMUL="${TP_COLLECTIVE_MATMUL:-0}"
 
 echo "Config:"
 for v in STRATEGY WORLD_SIZE NUM_PROCESSES RANK MASTER_ADDR MASTER_PORT \
@@ -220,6 +225,8 @@ if [ "${FLASH_BLOCKWISE_BACKWARD}" = "1" ]; then
 if [ "${RESUME}" = "1" ]; then ARGS="${ARGS} --resume"; fi
 if [ "${XLA_LATENCY_HIDING}" = "1" ]; then
   ARGS="${ARGS} --xla-latency-hiding"; fi
+if [ "${TP_COLLECTIVE_MATMUL}" = "1" ]; then
+  ARGS="${ARGS} --tp-collective-matmul"; fi
 if [ "${DEBUG}" = "1" ]; then ARGS="${ARGS} --debug"; fi
 if [ "${CHECKPOINT_ASYNC}" = "1" ]; then ARGS="${ARGS} --checkpoint-async"; fi
 if [ -n "${INJECT_FAULT}" ]; then

@@ -33,6 +33,10 @@ OFFLOAD_DELAYED_UPDATE=0
 OFFLOAD_DPU_START_STEP=0
 CAUSAL=0
 RING_ZIGZAG="auto"
+# Overlap round 3: 1 = collective-matmul tp fusion (ppermute-ring
+# projection comms, ops/collective_matmul.py; needs TENSOR_PARALLEL > 1
+# to have any effect).
+TP_COLLECTIVE_MATMUL=0
 # Flight-recorder heartbeat cadence (harness --heartbeat-sec); also drives
 # the job's livenessProbe — the probe period tracks the cadence and its
 # grace window is derived inside scripts/liveness_probe.sh (10x, floor
@@ -88,6 +92,7 @@ while [ $# -gt 0 ]; do
     --offload-delayed-update) OFFLOAD_DELAYED_UPDATE=1; shift 1 ;;
     --offload-dpu-start-step) OFFLOAD_DPU_START_STEP="$2"; shift 2 ;;
     --causal) CAUSAL=1; shift 1 ;;
+    --tp-collective-matmul) TP_COLLECTIVE_MATMUL=1; shift 1 ;;
     --ring-zigzag) RING_ZIGZAG="$2"; shift 2 ;;
     --heartbeat-sec) HEARTBEAT_SEC="$2"; shift 2 ;;
     --checkpoint-dir) CHECKPOINT_DIR="$2"; shift 2 ;;
@@ -175,6 +180,7 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{OFFLOAD_DPU_START_STEP}}|$OFFLOAD_DPU_START_STEP|g" \
     -e "s|{{CAUSAL}}|$CAUSAL|g" \
     -e "s|{{RING_ZIGZAG}}|$RING_ZIGZAG|g" \
+    -e "s|{{TP_COLLECTIVE_MATMUL}}|$TP_COLLECTIVE_MATMUL|g" \
     -e "s|{{HEARTBEAT_SEC}}|$HEARTBEAT_SEC|g" \
     -e "s|{{CHECKPOINT_DIR}}|$CHECKPOINT_DIR|g" \
     -e "s|{{CHECKPOINT_EVERY}}|$CHECKPOINT_EVERY|g" \

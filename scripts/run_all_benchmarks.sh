@@ -367,6 +367,8 @@ sp2-ulysses|zero2|--sequence-parallel 2 --attention ulysses|--sequence-parallel 
 moe-ep2|zero2|--num-experts 4 --expert-parallel 2|--num-experts 4 --expert-parallel 2
 moe8-ep2|zero2|--num-experts 8 --expert-parallel 2|--num-experts 8 --expert-parallel 2
 llama-tp2|fsdp|--model-family llama --tensor-parallel 2|--model-family llama --tensor-parallel 2
+llama-tp2-ddp|ddp|--model-family llama --tensor-parallel 2|--model-family llama --tensor-parallel 2
+llama-tp2-cmm|ddp|--model-family llama --tensor-parallel 2 --tp-collective-matmul|--model-family llama --tensor-parallel 2 --tp-collective-matmul
 llama-flagship|zero2|--model-family llama --per-device-batch 2 --grad-accum 2 --layer-loop unrolled --attention flash|--model-family llama --per-device-batch 2 --grad-accum 2 --layer-loop unrolled --attention flash
 "
   echo ""

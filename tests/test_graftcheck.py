@@ -454,20 +454,21 @@ def test_budget_pins_fsdp_dp4_tp2_fallback_dead():
     """The round-8 acceptance pin: the banked llama-fsdp-dp4-tp2 fallback
     is GONE from the frozen budgets — 13 replication-reshard suspects
     (collective-permutes in a pure dp x tp mesh) -> 0, permute/all-to-all
-    counts 0. The scan sibling banks its residual scan-carry fallback
-    explicitly so it cannot grow unnoticed."""
+    counts 0. Round 15's scan-carry kill retired the scan sibling's
+    banked residue too: its floor is now 0 (test_overlap.py pins it)."""
     budgets = hlo_audit.load_budgets()
     arm = budgets["arms"]["llama-fsdp-dp4-tp2"]
     assert arm["replication_reshard_suspects"] == 0
     assert arm["collectives"]["collective-permute"] == 0
     assert arm["collectives"]["all-to-all"] == 0
     scan = budgets["arms"]["llama-fsdp-dp4-tp2-scan"]
-    assert scan["replication_reshard_suspects"] == 4  # banked scan-carry
+    assert scan["replication_reshard_suspects"] == 0  # round-15 floor
 
 
 def test_injection_registry_covers_bad_fsdp_axis():
     assert set(hlo_audit._INJECTIONS) == {
-        "bad-kv-spec", "bad-fsdp-axis", "bad-pipeline-spec"
+        "bad-kv-spec", "bad-fsdp-axis", "bad-pipeline-spec",
+        "bad-forward-gather", "bad-cmm-ring",
     }
 
 
@@ -857,7 +858,7 @@ def test_cli_topology_v5e64_clean(topo_ok):
     proc = _cli("--topology", "v5e-64")
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "graftcheck topology: 1 tier(s), 0 finding(s)" in proc.stderr
-    assert proc.stderr.count("compiling 4 arm(s)") == 1
+    assert proc.stderr.count("compiling 5 arm(s)") == 1
 
 
 def test_cli_topology_injection_exits_one(topo_ok):
@@ -1478,6 +1479,46 @@ def test_run_lint_files_filter_scopes_findings(tmp_path):
     assert lint.run_lint(root=root, rules=("GC109",), files=(rel,)) == all_v
     assert lint.run_lint(
         root=root, rules=("GC109",), files=("somewhere/else.py",)
+    ) == []
+
+
+def test_changed_mode_covers_collective_matmul(tmp_path):
+    """Round-15 satellite: the --changed pre-commit path covers
+    ops/collective_matmul.py — the real file lints clean when scoped to
+    exactly it, and a cmm-shaped scratch file (shard_map ring body naming
+    a wrong literal axis) is caught by GC108 under the same scoping."""
+    rel = (
+        "distributed_llm_training_benchmark_framework_tpu/ops/"
+        "collective_matmul.py"
+    )
+    assert lint.run_lint(files=(rel,)) == []
+    root = _scratch_root(tmp_path, "ops/collective_matmul.py", """\
+        import jax
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        def ring(x, w):
+            chunk = lax.ppermute(x, "data", [(0, 1)])  # wrong axis
+            return chunk @ w
+
+        def ag_proj(mesh, x, w):
+            return jax.shard_map(
+                ring, mesh=mesh, in_specs=(P(None, "model", None), P()),
+                out_specs=P(None, None, "model"),
+                axis_names=("model",),
+            )(x, w)
+    """)
+    violations = lint.run_lint(root=root, rules=("GC108",))
+    assert len(violations) == 1 and "ppermute" in violations[0].message
+    rel_scratch = violations[0].path
+    assert rel_scratch.endswith("ops/collective_matmul.py")
+    # ...and the --changed scoping keeps the finding when the file is in
+    # the changed set, drops it when not.
+    assert lint.run_lint(
+        root=root, rules=("GC108",), files=(rel_scratch,)
+    ) == violations
+    assert lint.run_lint(
+        root=root, rules=("GC108",), files=("somewhere/else.py",)
     ) == []
 
 

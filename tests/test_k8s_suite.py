@@ -92,6 +92,13 @@ if "logs" in argv:
         result.update(expert_parallel=2, n_experts=8)
     elif comp == "llama-tp2":
         result.update(tensor_parallel=2, model_family="llama", causal=True)
+    elif comp == "llama-tp2-ddp":
+        result.update(tensor_parallel=2, model_family="llama", causal=True)
+    elif comp == "llama-tp2-cmm":
+        # The A/B partner differs from llama-tp2-ddp ONLY in the fusion
+        # knob — exactly the axis parse_metrics' dedup key must keep.
+        result.update(tensor_parallel=2, model_family="llama", causal=True,
+                      tp_collective_matmul=True)
     elif comp == "llama-flagship":
         result.update(model_family="llama", causal=True, per_device_batch=2,
                       grad_accum=2, attention_impl="flash")
@@ -213,6 +220,8 @@ COMP_JOBS = {
     "tpu-bench-zero2-ws4-moe-ep2",
     "tpu-bench-zero2-ws4-moe8-ep2",
     "tpu-bench-fsdp-ws4-llama-tp2",
+    "tpu-bench-ddp-ws4-llama-tp2-ddp",
+    "tpu-bench-ddp-ws4-llama-tp2-cmm",
     "tpu-bench-zero2-ws4-llama-flagship",
 }
 
@@ -246,10 +255,10 @@ def roster_run(tmp_path_factory):
     return proc, tmp, results
 
 
-def test_roster_exits_zero_with_twelve_arms(roster_run):
+def test_roster_exits_zero_with_fourteen_arms(roster_run):
     proc, _, _ = roster_run
     assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-2000:]
-    assert "12 passed, 0 failed" in proc.stdout
+    assert "14 passed, 0 failed" in proc.stdout
 
 
 def test_roster_job_names_and_manifest_env(roster_run):
@@ -281,6 +290,17 @@ def test_roster_job_names_and_manifest_env(roster_run):
     assert 'name: RING_ZIGZAG\n              value: "off"' in nozz
     # The llama-flagship arm carries its swept geometry (bench.py flagship
     # sub-object config, docs/PERFORMANCE.md §16) into the pod env.
+    cmm = (tmp / "manifest_tpu-bench-ddp-ws4-llama-tp2-cmm.yaml").read_text()
+    assert 'name: MODEL_FAMILY\n              value: "llama"' in cmm
+    assert 'name: TENSOR_PARALLEL\n              value: "2"' in cmm
+    assert 'name: TP_COLLECTIVE_MATMUL\n              value: "1"' in cmm
+    # ...and its A/B partner — same ddp strategy, same llama tp2 geometry,
+    # fusion OFF — so the pair differs ONLY in --tp-collective-matmul.
+    ab = (tmp / "manifest_tpu-bench-ddp-ws4-llama-tp2-ddp.yaml").read_text()
+    assert 'name: MODEL_FAMILY\n              value: "llama"' in ab
+    assert 'name: TENSOR_PARALLEL\n              value: "2"' in ab
+    assert 'name: TP_COLLECTIVE_MATMUL\n              value: "0"' in ab
+    assert 'name: TP_COLLECTIVE_MATMUL\n              value: "0"' in lm
     fl = (tmp / "manifest_tpu-bench-zero2-ws4-llama-flagship.yaml").read_text()
     assert 'name: MODEL_FAMILY\n              value: "llama"' in fl
     assert 'name: PER_DEVICE_BATCH\n              value: "2"' in fl
@@ -304,10 +324,10 @@ def test_roster_rows_survive_dedup(roster_run):
     import pandas as pd
 
     df = pd.read_csv(results / "summary" / "metrics.csv")
-    # 12 composition runs, all (strategy, ws)-colliding pairs kept distinct
+    # 14 composition runs, all (strategy, ws)-colliding pairs kept distinct
     # by the composition axes in the identity key (sp2-ring vs
     # sp2-ring-causal collide on everything except the causal column; the
     # zigzag A/B pair only on ring_zigzag; the two MoE arms only on
     # n_experts; the llama arms on model_family + tensor_parallel and on
     # the flagship's batch geometry + attention impl).
-    assert len(df) == 12, df
+    assert len(df) == 14, df

@@ -1,6 +1,8 @@
-"""Overlap round 2 (PR 8) coverage: the zero2 per-block grad-comms path,
-the latency-hiding scheduler flag plumbing, and the registry lineage
-separation for scheduler-flagged / remat-swept runs.
+"""Overlap rounds 2 + 3 coverage: the zero2 per-block grad-comms path
+(PR 8), the fsdp/zero3 forward-side per-block param placement, the
+scan-carry kill, the collective-matmul tp fusion (round 15), the
+latency-hiding scheduler flag plumbing, and the registry lineage
+separation for scheduler-flagged / remat-swept / collective-matmul runs.
 
 Three layers:
 
@@ -188,6 +190,372 @@ def test_zero2_shape_arms_block_grad_spec(eight_devices):
 
 
 # ---------------------------------------------------------------------------
+# Round 15 (a): fsdp/zero3 forward-side per-block param placement
+# ---------------------------------------------------------------------------
+
+
+def test_fsdp_shape_arms_block_param_spec(eight_devices):
+    """The step arms the per-layer-slice PARAM placement exactly for the
+    sharded-param shapes (fsdp/zero3, incl. composed dp x tp meshes) —
+    ddp/zero2 have nothing to gather, pipeline keeps the manual path, and
+    layers-axis-sharded leaves are skipped like the zero2 grad rule."""
+    import functools
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+        strategies as strat,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train import (
+        step as step_mod,
+    )
+
+    mesh = make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    cfg = tinygpt.get_model_config("S", 64)
+    params_shape = jax.eval_shape(
+        functools.partial(tinygpt.init_params, cfg), jax.random.key(0)
+    )
+    specs = strat.param_partition_specs(
+        params_shape, mesh, shard=True, kv_heads=cfg.kv_heads,
+    )
+    for name in ("fsdp", "zero3"):
+        armed = step_mod.fsdp_block_param_spec(get_strategy(name), specs, False)
+        assert armed, f"{name} must arm the per-block param placement"
+        for leaf, spec in armed:
+            # The layer-slice spec is the stacked spec minus its layers axis.
+            assert tuple(spec) == tuple(specs["blocks"][leaf])[1:]
+    # Replicated-param strategies and pipeline shapes stay None.
+    assert step_mod.fsdp_block_param_spec(get_strategy("ddp"), specs, False) is None
+    assert step_mod.fsdp_block_param_spec(get_strategy("zero2"), specs, False) is None
+    assert step_mod.fsdp_block_param_spec(get_strategy("fsdp"), specs, True) is None
+    # A leaf whose shard fell back to the stacked LAYERS axis is skipped.
+    forced = {**specs, "blocks": {**specs["blocks"], "wqkv": P("data")}}
+    assert "wqkv" not in dict(
+        step_mod.fsdp_block_param_spec(get_strategy("fsdp"), forced, False)
+    )
+    # The injection escape hatch reverts the arming — and self-restores.
+    step_mod._FORWARD_GATHER_OVERLAP = False
+    try:
+        assert step_mod.fsdp_block_param_spec(
+            get_strategy("fsdp"), specs, False
+        ) is None
+    finally:
+        step_mod._FORWARD_GATHER_OVERLAP = True
+
+
+FSDP_UNROLLED = hlo_audit.ArmSpec(
+    "fsdp-dp4-unrolled", "fsdp", (4,), ("data",),
+    global_batch=4, model_family="tinygpt",
+    config_overrides=(("scan_layers", False),),
+)
+ZERO3_UNROLLED = hlo_audit.ArmSpec(
+    "zero3-dp4-unrolled", "zero3", (4,), ("data",),
+    global_batch=4, model_family="tinygpt",
+    config_overrides=(("scan_layers", False), ("remat", "none")),
+)
+
+
+@pytest.mark.parametrize(
+    "spec", [FSDP_UNROLLED, ZERO3_UNROLLED], ids=["fsdp", "zero3"]
+)
+def test_forward_param_gathers_interleave_with_forward_dots(
+    eight_devices, spec
+):
+    """Round-15 forward overlap shape: the unrolled sharded-param arms'
+    weight all-gathers must appear INTERLEAVED with the forward's dot ops
+    in the optimized module — never bundled wholesale above the first dot,
+    where the layer stack would serialize behind one monolithic gather
+    phase."""
+    txt = hlo_audit.lower_arm(spec).as_text()
+    lines = txt.splitlines()
+    ags = [i for i, l in enumerate(lines)
+           if re.search(r"= \S+ all-gather\(", l)]
+    dots = [i for i, l in enumerate(lines) if re.search(r"= \S+ dot\(", l)]
+    assert ags and dots
+    first_dot = min(dots)
+    hoisted = [i for i in ags if i < first_dot]
+    assert len(hoisted) < len(ags) // 2, (
+        f"{len(hoisted)}/{len(ags)} weight all-gathers sit above the first "
+        "dot — the forward gathers have collapsed into a head bundle"
+    )
+
+
+def test_scan_carry_spec_arming_matrix(eight_devices):
+    """scan_carry_spec arms exactly for sharded-param (fsdp/zero3),
+    scanned, non-pipelined arms on composed dp x tp meshes — never for
+    replicated-param strategies (they cannot exhibit the stash-reshard
+    pathology, so e.g. the ddp llama-tp2-gqa topology clients stay
+    byte-frozen) and never for the collective-matmul path, which owns
+    its own (sequence-sharded) residual layout."""
+    import dataclasses as _dc
+
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train import (
+        step as step_mod,
+    )
+
+    composed = make_mesh(
+        (2, 1, 2), ("data", "seq", "model"), devices=jax.devices()[:4]
+    )
+    pure_dp = make_mesh((4,), ("data",), devices=jax.devices()[:4])
+    cfg = tinygpt.get_model_config("S", 64)
+    fsdp, zero3 = get_strategy("fsdp"), get_strategy("zero3")
+    assert step_mod.scan_carry_spec(
+        fsdp, composed, cfg, False
+    ) == P(("data",), None, None)
+    assert step_mod.scan_carry_spec(
+        zero3, composed, cfg, False
+    ) == P(("data",), None, None)
+    # Replicated-param strategies never arm.
+    assert step_mod.scan_carry_spec(
+        get_strategy("ddp"), composed, cfg, False
+    ) is None
+    assert step_mod.scan_carry_spec(
+        get_strategy("zero2"), composed, cfg, False
+    ) is None
+    assert step_mod.scan_carry_spec(fsdp, pure_dp, cfg, False) is None
+    assert step_mod.scan_carry_spec(fsdp, composed, cfg, True) is None
+    assert step_mod.scan_carry_spec(
+        fsdp, composed, _dc.replace(cfg, scan_layers=False), False
+    ) is None
+    assert step_mod.scan_carry_spec(
+        fsdp, composed, _dc.replace(cfg, tp_collective_matmul=True), False
+    ) is None
+
+
+def test_scan_carry_budget_floor():
+    """The scan-carry kill's new floor is FROZEN: the banked 4
+    replication-reshard suspects on llama-fsdp-dp4-tp2-scan are gone from
+    the committed budget (target 0, achieved 0 — the composed-mesh scan
+    lowering no longer pays permute chains), and the unrolled sibling's
+    budget stayed at its round-8 profile."""
+    budgets = hlo_audit.load_budgets()
+    scan = budgets["arms"]["llama-fsdp-dp4-tp2-scan"]
+    assert scan["replication_reshard_suspects"] == 0
+    assert scan["collectives"]["collective-permute"] == 0
+    unrolled = budgets["arms"]["llama-fsdp-dp4-tp2"]
+    assert unrolled["replication_reshard_suspects"] == 0
+    assert unrolled["collectives"]["collective-permute"] == 0
+
+
+def test_contraction_skip_rule_is_scan_scoped(eight_devices):
+    """The _COMPOSED_CONTRACTION_DATA_SKIP rule (wq stays model-only) only
+    applies to the scanned lowering: unrolled specs keep the round-8
+    placement so the suite's measured arm budget stays byte-identical."""
+    import functools
+
+    from distributed_llm_training_benchmark_framework_tpu.models.llama import (
+        get_llama_config,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        strategies as strat,
+    )
+
+    mesh = make_mesh(
+        (4, 1, 2), ("data", "seq", "model"), devices=jax.devices()[:8]
+    )
+    cfg = get_llama_config("S", 64)
+    shapes = jax.eval_shape(
+        functools.partial(tinygpt.init_params, cfg), jax.random.key(0)
+    )
+    scanned = strat.param_partition_specs(
+        shapes, mesh, shard=True, kv_heads=cfg.kv_heads, scan_stacked=True
+    )
+    unrolled = strat.param_partition_specs(
+        shapes, mesh, shard=True, kv_heads=cfg.kv_heads, scan_stacked=False
+    )
+    assert tuple(scanned["blocks"]["wq"]) == (None, None, "model")
+    assert tuple(unrolled["blocks"]["wq"]) == (None, "data", "model")
+    # The big leaves keep their fsdp 'data' split in BOTH lowerings.
+    assert "data" in tuple(scanned["blocks"]["wgu"])
+    assert "data" in tuple(scanned["blocks"]["wkv"])
+
+
+# ---------------------------------------------------------------------------
+# Round 15 (b): collective-matmul tp fusion
+# ---------------------------------------------------------------------------
+
+
+CMM_ARM = hlo_audit.ROSTER["llama-tp2-gqa-cmm"]
+
+
+def _cmm_configs(family):
+    import jax.numpy as jnp
+
+    from distributed_llm_training_benchmark_framework_tpu.models.llama import (
+        get_llama_config,
+    )
+
+    base = (
+        get_llama_config("S", 64) if family == "llama"
+        else tinygpt.get_model_config("S", 64)
+    )
+    cfg = dataclasses.replace(
+        base, dropout=0.0,
+        compute_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    return cfg, dataclasses.replace(cfg, tp_collective_matmul=True)
+
+
+@pytest.mark.parametrize("family", ["llama", "tinygpt"])
+def test_cmm_matches_plain_tp_forward_and_grads(eight_devices, family):
+    """Lowering equivalence: the collective-matmul path computes the SAME
+    loss and gradients as the plain tp lowering (fp32, tp=2) — llama
+    covers the GQA split projections incl. the misaligned-kv replicated
+    ring; tinygpt covers the fused-wqkv and GELU-MLP shapes."""
+    import jax.numpy as jnp
+
+    cfg, cfg_cmm = _cmm_configs(family)
+    mesh = make_mesh(
+        (1, 1, 2), ("data", "seq", "model"), devices=jax.devices()[:2]
+    )
+    params = tinygpt.init_params(cfg, jax.random.key(0))
+    idx = jax.random.randint(jax.random.key(1), (2, 64), 0, cfg.vocab_size)
+
+    def loss_of(c):
+        return lambda p: tinygpt.loss_fn(c, p, idx, idx, None, True)
+
+    with jax.set_mesh(mesh):
+        l0, g0 = jax.jit(jax.value_and_grad(loss_of(cfg)))(params)
+        l1, g1 = jax.jit(jax.value_and_grad(loss_of(cfg_cmm)))(params)
+    assert abs(float(l0) - float(l1)) < 1e-5
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-5
+
+
+def test_cmm_falls_back_to_plain_einsum_without_model_axis(eight_devices):
+    """The knob is inert on a pure-dp mesh: ag_proj/rs_proj fall back to
+    the plain einsum, so a --tp-collective-matmul run without tensor
+    parallelism computes identically (and lowers no rings)."""
+    import jax.numpy as jnp
+
+    from distributed_llm_training_benchmark_framework_tpu.ops import (
+        collective_matmul as cm,
+    )
+
+    mesh = make_mesh((2,), ("data",), devices=jax.devices()[:2])
+    x = jax.random.normal(jax.random.key(0), (2, 8, 16))
+    w = jax.random.normal(jax.random.key(1), (16, 12))
+    with jax.set_mesh(mesh):
+        y = jax.jit(lambda a, b: cm.ag_proj(a, b))(x, w)
+        z = jax.jit(lambda a, b: cm.rs_proj(a, b))(y, w.T)
+    ref = jnp.einsum("bsd,df->bsf", x, w, preferred_element_type=jnp.float32)
+    assert float(jnp.max(jnp.abs(y - ref))) < 1e-5
+    assert z.shape == (2, 8, 16)
+
+
+def test_cmm_ring_replaces_projection_gathers(eight_devices):
+    """The fusion's HLO signature on the audited arm: the layer stack
+    (the scanned while-loop bodies) lowers ZERO all-gathers — every
+    projection's comms are ppermute ring hops — and the only gathers left
+    sit in ENTRY (the embed/head/loss boundary outside the stack)."""
+    txt = hlo_audit.lower_arm(CMM_ARM).as_text()
+    comp = None
+    body_gathers, permutes = [], 0
+    for l in txt.splitlines():
+        if l and not l[0].isspace() and "{" in l:
+            comp = l.split("{")[0].strip()
+        if re.search(r"= \S+ all-gather\(", l) and not comp.startswith("ENTRY"):
+            body_gathers.append(l.strip()[:80])
+        if re.search(r"= \S+ collective-permute\(", l):
+            permutes += 1
+    assert body_gathers == [], (
+        "projection all-gathers survived inside the layer stack:\n"
+        + "\n".join(body_gathers)
+    )
+    assert permutes > 0, "no ppermute ring lowered at all?"
+
+
+def test_cmm_arm_budget_is_frozen_with_ring_signature():
+    """The committed budget IS the fusion claim: projection all-gathers
+    collapsed (21 on the plain gqa arm -> 5 boundary gathers), the
+    ppermute ring in their place, reshard suspects 0 — and the plain arm's
+    budget is untouched, so the A/B pair stays auditable."""
+    budgets = hlo_audit.load_budgets()
+    cmm = budgets["arms"]["llama-tp2-gqa-cmm"]
+    plain = budgets["arms"]["llama-tp2-gqa"]
+    assert cmm["collectives"]["collective-permute"] > 0
+    assert cmm["collectives"]["all-gather"] < plain["collectives"]["all-gather"]
+    assert cmm["replication_reshard_suspects"] == 0
+    assert plain["collectives"]["collective-permute"] == 0
+
+
+def test_cmm_refuses_incompatible_compositions(eight_devices):
+    """--tp-collective-matmul refuses pipeline / sequence-parallel / MoE
+    compositions loudly (both want to own the sequence/token layout)."""
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import (
+        run_benchmark,
+    )
+
+    common = dict(
+        strategy=get_strategy("ddp"), tier="S", seq_len=64, steps=2,
+        warmup_steps=0, per_device_batch=1, grad_accum=1, world_size=4,
+        results_dir=None, telemetry=False, tp_collective_matmul=True,
+    )
+    with pytest.raises(ValueError, match="pipeline"):
+        run_benchmark(pipeline_parallel=2, tensor_parallel=2, **common)
+    with pytest.raises(ValueError, match="sequence"):
+        run_benchmark(sequence_parallel=2, tensor_parallel=2,
+                      attention_impl="ring", **common)
+    with pytest.raises(ValueError, match="MoE"):
+        run_benchmark(n_experts=4, tensor_parallel=2, **common)
+
+
+def test_cmm_injection_registry_and_flag_restore(eight_devices):
+    """bad-forward-gather and bad-cmm-ring are registered injections; each
+    reverts its flag for the duration of the lowering and self-restores."""
+    import dataclasses as _dc
+
+    from distributed_llm_training_benchmark_framework_tpu.ops import (
+        collective_matmul as cm,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train import (
+        step as step_mod,
+    )
+
+    assert "bad-forward-gather" in hlo_audit._INJECTIONS
+    assert "bad-cmm-ring" in hlo_audit._INJECTIONS
+    rep = hlo_audit.audit_arm(
+        _dc.replace(CMM_ARM, inject="bad-cmm-ring")
+    )
+    assert cm._CMM_RING is True  # restored
+    # The unfused lowering: bulk collectives back, ring gone.
+    assert rep.collectives["collective-permute"] == 0
+    assert rep.collectives["reduce-scatter"] > 0
+    budgets = hlo_audit.load_budgets()
+    deltas = hlo_audit.diff_against_budget(rep, budgets)
+    assert any("all-gather" in d and "REGRESSED" in d for d in deltas), deltas
+    assert step_mod._FORWARD_GATHER_OVERLAP is True
+
+
+def test_cmm_arm_joins_topology_roster_with_flat_ring():
+    """Satellite: the cmm arm is audited at the topology tiers, and its
+    frozen ppermute count is FLAT along the data axis (the ring is a
+    function of the tp degree alone)."""
+    assert "llama-tp2-gqa-cmm" in hlo_audit.TOPOLOGY_ARMS
+    budgets = hlo_audit.load_budgets()
+    tiers = budgets["topology_tiers"]
+    counts = {
+        t: tiers[t]["arms"]["llama-tp2-gqa-cmm"]["collectives"][
+            "collective-permute"
+        ]
+        for t in ("v5e-16", "v5e-64")
+        if "llama-tp2-gqa-cmm" in tiers.get(t, {}).get("arms", {})
+    }
+    assert len(counts) == 2, tiers.keys()
+    assert len(set(counts.values())) == 1, counts
+    assert all(
+        tiers[t]["arms"]["llama-tp2-gqa-cmm"]["replication_reshard_suspects"]
+        == 0
+        for t in counts
+    )
+
+
+# ---------------------------------------------------------------------------
 # Platform units: the latency-hiding flag set
 # ---------------------------------------------------------------------------
 
@@ -295,6 +663,47 @@ def test_scheduler_flags_join_config_key_aa():
     assert rstore.config_key(legacy) == rstore.config_key(plain)
     # The flags are triage-visible in the env fingerprint too.
     assert flagged["env"]["xla_scheduler_flags"] != ""
+
+
+def test_cmm_joins_config_key_aa():
+    """A/A: identical measurements with and without the collective-matmul
+    fusion are DIFFERENT lineages (the projection schedule changed), so
+    cmm and plain-tp runs never cross-gate; legacy rows (no field) stay
+    in the plain lineage. Mirrors the xla_scheduler_flags split."""
+    plain = _rec()
+    cmm = _rec(tp_collective_matmul=True)
+    assert rstore.config_key(plain) == rstore.config_key(_rec())
+    assert rstore.config_key(plain) != rstore.config_key(cmm)
+    legacy = _rec()
+    legacy["result"].pop("tp_collective_matmul", None)
+    assert rstore.config_key(legacy) == rstore.config_key(plain)
+    # Triage-visible in the env fingerprint too.
+    assert cmm["env"]["tp_collective_matmul"] is True
+    assert plain["env"]["tp_collective_matmul"] is False
+
+
+def test_cmm_flag_surface_and_row_stamp():
+    """Wiring pins: the harness, bench.py and the container env all carry
+    --tp-collective-matmul, and bench.py stamps the row only when live
+    (default rows stay byte-identical — the plain lineage)."""
+    from distributed_llm_training_benchmark_framework_tpu.train.harness import (
+        build_parser,
+    )
+
+    flags = {o for a in build_parser()._actions for o in a.option_strings}
+    assert "--tp-collective-matmul" in flags
+    entry = open(os.path.join(REPO, "docker", "entrypoint.sh")).read()
+    assert "TP_COLLECTIVE_MATMUL" in entry
+    assert "--tp-collective-matmul" in entry
+    bench_src = open(os.path.join(REPO, "bench.py")).read()
+    assert "--tp-collective-matmul" in bench_src
+    assert 'row_extra["tp_collective_matmul"]' in bench_src
+    suite = open(
+        os.path.join(REPO, "scripts", "run_all_benchmarks.sh")
+    ).read()
+    assert "llama-tp2-cmm" in suite
+    launch = open(os.path.join(REPO, "scripts", "launch_multi.sh")).read()
+    assert "--tp-collective-matmul" in launch
 
 
 def test_remat_policy_joins_config_key_per_policy():

@@ -24,7 +24,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 COMPOSITION_ARMS = {
     "tp2", "pp2-gpipe", "pp2-1f1b", "pp2-interleaved",
     "sp2-ring", "sp2-ring-causal", "sp2-ring-causal-nozz", "sp2-ulysses",
-    "moe-ep2", "moe8-ep2", "llama-tp2", "llama-flagship",
+    "moe-ep2", "moe8-ep2", "llama-tp2", "llama-tp2-ddp", "llama-tp2-cmm",
+    "llama-flagship",
 }
 
 
