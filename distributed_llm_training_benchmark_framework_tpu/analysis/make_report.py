@@ -436,6 +436,24 @@ def build_report(
         df["n_rollbacks"].fillna(0) > 0
     ).any():
         cols.append("n_rollbacks")
+    # Supervisor-recovered rows (elastic fleet supervisor,
+    # runtime/supervisor.py): the arm died and the supervisor restarted
+    # it — possibly through a geometry shrink leg — until it finished.
+    # Show the recovery history (attempt count, actions taken, shrink
+    # legs) beside the healed/partial accounting; like those rows, they
+    # are excluded from scaling-efficiency baselines upstream.
+    has_supervised = "supervised_attempts" in df.columns and (
+        df["supervised_attempts"].fillna(0).astype(float) > 1
+    ).any()
+    if has_supervised:
+        df["supervised_attempts"] = (
+            df["supervised_attempts"].fillna(1).astype(int)
+        )
+        cols.append("supervised_attempts")
+        for c in ("supervised_actions", "supervised_shrink_legs"):
+            if c in df.columns:
+                df[c] = df[c].fillna("").replace("", "-")
+                cols.append(c)
     cols = [c for c in cols if c in df.columns]
     out = ["# TPU Distributed Training Benchmark Report", ""]
 
@@ -526,6 +544,23 @@ def build_report(
             f"- **Partial rows:** {n_partial} arm(s) died before their "
             "final result marker; their rows come from heartbeat salvage "
             f"(last sync window){death} — see the `partial` column."
+        )
+    if has_supervised:
+        sup = df[df["supervised_attempts"] > 1]
+        n_shrunk = int((sup["supervised_shrink_legs"] != "-").sum()) if (
+            "supervised_shrink_legs" in sup.columns
+        ) else 0
+        shrink_txt = (
+            f", {n_shrunk} via a geometry shrink leg "
+            "(resumed on fewer chips from the checkpoint's geometry "
+            "sidecar)" if n_shrunk else ""
+        )
+        out.append(
+            f"- **Supervised recoveries:** {len(sup)} arm(s) finished "
+            "only after the fleet supervisor restarted them"
+            f"{shrink_txt} — attempt counts and actions in the "
+            "`supervised_*` columns; full per-attempt ledger in each "
+            "arm's `supervision.json`."
         )
     out.append("")
 

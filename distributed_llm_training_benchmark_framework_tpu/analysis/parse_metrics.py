@@ -110,15 +110,57 @@ def _flatten_memory_anatomy(row: dict) -> dict:
     return row
 
 
+def _flatten_supervision(row: dict) -> dict:
+    """Expand the fleet supervisor's recovery-history stamp into scalar
+    CSV columns.
+
+    ``supervision`` is the summary the supervisor copies from its
+    ``supervision.json`` ledger onto the final result row of a RECOVERED
+    run (runtime/supervisor.py): attempt count, the actions taken, and
+    any geometry shrink/regrow legs. Flattened beside the existing
+    resumed/healed/partial accounting so the report (and a human
+    grepping the CSV) sees the whole recovery history; unsupervised
+    rows omit the columns entirely.
+    """
+    sup = row.pop("supervision", None)
+    if isinstance(sup, dict):
+        row["supervised_attempts"] = sup.get("n_attempts")
+        row["supervised_actions"] = ",".join(sup.get("actions") or [])
+        row["supervised_shrink_legs"] = ",".join(sup.get("shrink_legs") or [])
+    return row
+
+
+def _note_give_up_ledgers(results_dir: str) -> None:
+    """Name every supervision ledger that ended in give-up: those arms
+    published no result row (at most a salvaged partial), so the ledger
+    on disk is their only first-class trace — surface it here rather
+    than letting the aggregation silently read as 'arm never ran'."""
+    for path in sorted(Path(results_dir).rglob("supervision*.json")):
+        try:
+            with open(path) as f:
+                ledger = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            continue
+        if ledger.get("gave_up"):
+            print(
+                f"NOTE: supervisor gave up after "
+                f"{ledger.get('n_attempts')} attempt(s) "
+                f"(final class: {ledger.get('final_class')}) — see {path}"
+            )
+
+
 def load_results(results_dir: str) -> pd.DataFrame:
     rows = []
     for path in sorted(Path(results_dir).rglob("result*.json")):
         try:
             with open(path) as f:
-                rows.append(_flatten_memory_anatomy(json.load(f)))
+                rows.append(
+                    _flatten_supervision(_flatten_memory_anatomy(json.load(f)))
+                )
         except (json.JSONDecodeError, OSError) as e:
             print(f"WARNING: skipping unreadable {path}: {e}")
     n_full = len(rows)
+    _note_give_up_ledgers(results_dir)
     for path in sorted(Path(results_dir).rglob("partial_*.json")):
         try:
             with open(path) as f:
@@ -204,6 +246,12 @@ def add_scaling_efficiency(df: pd.DataFrame) -> pd.DataFrame:
             ineligible_base |= eligible[col].fillna(False).astype(bool)
     if "n_rollbacks" in eligible.columns:
         ineligible_base |= eligible["n_rollbacks"].fillna(0).astype(float) > 0
+    if "supervised_attempts" in eligible.columns:
+        # Supervisor-recovered rows (attempt > 1: the measurement spans a
+        # restart, possibly a geometry shrink leg) never anchor the ideal.
+        ineligible_base |= (
+            eligible["supervised_attempts"].fillna(1).astype(float) > 1
+        )
     # dropna=False: rows from before a schema addition carry NaN in the
     # newer axis columns and must still get their efficiency computed
     # (pandas silently drops NaN-keyed groups by default).

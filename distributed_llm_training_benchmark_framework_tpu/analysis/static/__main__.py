@@ -158,8 +158,8 @@ def main(argv=None) -> int:
                         "are memory-audited fresh; with --update-budgets, "
                         "freezes the memory_budgets section (only)")
     p.add_argument("--arms", default=None,
-                   help="comma-separated arm subset for --audit/--memory "
-                        "(default: the whole roster)")
+                   help="comma-separated arm subset for --audit/--memory/"
+                        "--topology (default: the whole roster)")
     p.add_argument("--topology", default=None,
                    help="comma-separated topology tier(s) "
                         "(v5e-16|v5e-64|v5e-256): AOT-compile the scalable "
@@ -207,6 +207,12 @@ def main(argv=None) -> int:
         # make the known-bad schedule the audited baseline.
         p.error("--inject is a self-test knob and cannot be combined with "
                 "--update-budgets")
+
+    if args.arms and args.topology and args.update_budgets:
+        # write_topology_budgets replaces a tier's arms block wholesale;
+        # freezing a subset would silently drop the other arms' pins.
+        p.error("--arms with --topology --update-budgets would freeze a "
+                "partial tier; freeze whole tiers")
 
     # Static tool: never let it spin up a TPU backend (lint's GC201 imports
     # the harness module, and the audit must match the budgets' freeze
@@ -431,16 +437,31 @@ def main(argv=None) -> int:
     if do_topology:
         budgets_path = args.budgets or hlo_audit.DEFAULT_BUDGETS_PATH
         tiers = topo_tiers or list(hlo_audit.TOPOLOGY_DEFAULT_TIERS)
+        # Subset only an EXPLICIT --topology request: under --all the
+        # roster subset in --arms addresses the CPU audit, not the tiers.
+        topo_arm_names = None
+        if args.arms and topo_tiers:
+            requested = [a.strip() for a in args.arms.split(",") if a.strip()]
+            unknown = [
+                n for n in requested if n not in hlo_audit.TOPOLOGY_ARMS
+            ]
+            if unknown:
+                print(f"graftcheck topology: unknown arm(s) {unknown}; "
+                      f"topology roster: {list(hlo_audit.TOPOLOGY_ARMS)}",
+                      file=sys.stderr)
+                return 2
+            topo_arm_names = tuple(requested)
         fresh = {}
         try:
             for tier_name in tiers:
                 tier = hlo_audit.TOPOLOGY_TIERS[tier_name]
+                n_arms = len(topo_arm_names or hlo_audit.TOPOLOGY_ARMS)
                 print(f"graftcheck topology: compiling "
-                      f"{len(hlo_audit.TOPOLOGY_ARMS)} arm(s) against "
+                      f"{n_arms} arm(s) against "
                       f"{tier_name} ({tier.topology_name}, "
                       f"{tier.device_count} devices) ...", file=sys.stderr)
                 fresh[tier_name] = hlo_audit.audit_topology_tier(
-                    tier, inject=args.inject
+                    tier, arm_names=topo_arm_names, inject=args.inject
                 )
         except hlo_audit.TopologyUnavailable as e:
             if topo_tiers:

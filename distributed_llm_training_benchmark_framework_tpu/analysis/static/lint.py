@@ -998,6 +998,111 @@ def _check_schedule_loop_reshards(root: str) -> Iterator[Violation]:
 
 
 # ---------------------------------------------------------------------------
+# GC112: hard-coded exit-code literals outside the central EXIT_* registry
+# ---------------------------------------------------------------------------
+
+#: Receiver names that mark a comparison as exit-code-shaped: `rc == 75`,
+#: `proc.returncode in (75, 76)`, `exit_code != 77`. Deliberately narrow —
+#: a bare 75 elsewhere (a percentile, a size) is not this rule's business.
+_GC112_RECEIVER = re.compile(
+    r"(^|_)(rc|returncode|exit_?code|exit_?status)(_|\d*$)", re.IGNORECASE
+)
+_GC112_EXIT_NAME = re.compile(r"^EXIT_[A-Z0-9_]+$")
+#: Call targets whose integer argument IS a process exit code.
+_GC112_EXIT_CALLS = frozenset({"sys.exit", "os._exit", "exit", "SystemExit"})
+
+
+def _gc112_registry(root: str):
+    """Harvest the central registry: every module-level ``EXIT_NAME = int``
+    assignment in the package -> {value: name}, plus the defining
+    (file, line) pairs (exempt by construction — the registry itself is
+    the one place the literals belong)."""
+    values: Dict[int, str] = {}
+    defining = set()
+    for tree in _package_files(root, ("",)):
+        for node in tree.ast.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not (isinstance(target, ast.Name)
+                    and _GC112_EXIT_NAME.match(target.id)):
+                continue
+            if isinstance(node.value, ast.Constant) and isinstance(
+                node.value.value, int
+            ):
+                values[node.value.value] = target.id
+                defining.add((tree.rel, node.lineno))
+    return values, defining
+
+
+def _gc112_compare_is_exitish(node: ast.Compare) -> bool:
+    for side in [node.left, *node.comparators]:
+        ident = None
+        if isinstance(side, ast.Attribute):
+            ident = side.attr
+        elif isinstance(side, ast.Name):
+            ident = side.id
+        if ident and _GC112_RECEIVER.search(ident):
+            return True
+    return False
+
+
+def _gc112_literals(node: ast.AST) -> Iterator[ast.Constant]:
+    """Int literals inside one expression (tuples/lists/sets unpacked —
+    the ``rc in (75, 76)`` shape)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and type(sub.value) is int:
+            yield sub
+
+
+@_rule(
+    "GC112",
+    "hard-coded-exit-code-literal",
+    "a registry exit-code value (EXIT_PREEMPTED 75 / EXIT_HUNG 76 / "
+    "EXIT_NOTHING_TO_RESUME 77 / EXIT_DATA_STALL 78 — harvested, not "
+    "hard-coded here either) as a bare integer literal in an exit call "
+    "or an exit-code comparison, outside the defining EXIT_* assignment",
+    "import the named constant from the faults package (e.g. "
+    "`from ..faults import EXIT_PREEMPTED`) instead of its integer value — "
+    "the renumbering that moved EXIT_NOTHING_TO_RESUME 76 -> 77 is exactly "
+    "the drift this rule exists to catch",
+)
+def _check_exit_code_literals(root: str) -> Iterator[Violation]:
+    values, defining = _gc112_registry(root)
+    if not values:
+        return
+    for tree in _package_files(root, ("",)):
+        for node in ast.walk(tree.ast):
+            hits: List[ast.Constant] = []
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if name in _GC112_EXIT_CALLS:
+                    hits = [
+                        c for arg in node.args for c in _gc112_literals(arg)
+                    ]
+            elif isinstance(node, ast.Compare):
+                if _gc112_compare_is_exitish(node):
+                    hits = [
+                        c for side in [node.left, *node.comparators]
+                        for c in _gc112_literals(side)
+                    ]
+            for lit in hits:
+                if lit.value not in values:
+                    continue
+                if (tree.rel, lit.lineno) in defining:
+                    continue
+                if _suppressed(tree, lit.lineno, "GC112"):
+                    continue
+                yield Violation(
+                    "GC112", tree.rel, lit.lineno,
+                    f"hard-coded exit code {lit.value} "
+                    f"({values[lit.value]}) outside the central EXIT_* "
+                    "registry",
+                    RULES["GC112"].fix_hint,
+                )
+
+
+# ---------------------------------------------------------------------------
 # GC201: entrypoint <-> harness flag-surface drift
 # ---------------------------------------------------------------------------
 

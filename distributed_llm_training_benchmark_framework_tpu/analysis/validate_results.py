@@ -298,6 +298,27 @@ def validate_result(r: dict, name: str) -> List[str]:
             "stitch accounting is incoherent"
         )
 
+    # Supervision-stamp coherence (elastic fleet supervisor, runtime/
+    # supervisor.py): the stamp exists only on RECOVERED rows, so
+    # n_attempts must say so, and a recorded shrink leg means the final
+    # attempt restored a checkpoint on a different geometry — the row
+    # must carry the elastic-resume accounting too.
+    sup = r.get("supervision")
+    if sup is not None:
+        n_att = int(sup.get("n_attempts") or 0)
+        _check(
+            n_att > 1, name,
+            f"supervision stamp with n_attempts={n_att} — the supervisor "
+            "stamps only recovered rows (attempt > 1); the recovery "
+            "ledger is incoherent", f,
+        )
+        if sup.get("shrink_legs") and not r.get("resume_geometry_changed"):
+            f.append(
+                f"{name}: supervision.shrink_legs={sup.get('shrink_legs')} "
+                "but resume_geometry_changed=false — a shrink leg IS a "
+                "resharded resume; the recovery accounting is incoherent"
+            )
+
     # MFU floors for the published-arm geometry only: tier A, single chip,
     # v5e, flash attention, dense model, device-resident optimizer, and
     # windowed timing (sync_every > 1 — the per-step block_until_ready

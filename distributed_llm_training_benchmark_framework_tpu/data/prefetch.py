@@ -224,5 +224,25 @@ class HostPrefetcher:
                 )
             return arr, meta, time.perf_counter() - t0
 
-    def stop(self) -> None:
+    def stop(self, join: bool = False, timeout: float = 10.0) -> None:
+        """Signal the producer to exit; with ``join=True`` also wait.
+
+        The join exists for the sentinel's stream rewind (train/loop.py
+        ``_roll_back_if_tripped``): the producer thread advances
+        ``stream.cursor`` as it reads ahead, so a ``stream.seek()``
+        issued while the thread still runs could be silently overwritten
+        by an in-flight batch. Joining — and draining the queue so a
+        producer blocked on a full queue wakes up to see the stop event
+        — guarantees the stream is quiescent before the rewind. The
+        plain (no-join) form is the shutdown path's fire-and-forget.
+        """
         self._stop.set()
+        if not join:
+            return
+        deadline = time.perf_counter() + timeout
+        while self._thread.is_alive() and time.perf_counter() < deadline:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=_PUT_POLL_SEC)

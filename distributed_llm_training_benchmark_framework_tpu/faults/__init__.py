@@ -37,6 +37,7 @@ resume) or a correctly classified partial — docs/FAULT_TOLERANCE.md is
 the operator contract.
 """
 
+from ..data.stream import EXIT_DATA_STALL  # noqa: F401  (central registry)
 from .injection import (  # noqa: F401
     DATA_KINDS,
     FAULT_KINDS,
@@ -67,6 +68,7 @@ __all__ = [
     "FaultInjector",
     "FaultSpec",
     "parse_fault_spec",
+    "EXIT_DATA_STALL",
     "EXIT_NOTHING_TO_RESUME",
     "EXIT_PREEMPTED",
     "EXIT_HUNG",

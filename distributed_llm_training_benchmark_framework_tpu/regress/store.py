@@ -547,7 +547,8 @@ class Registry:
         :meth:`history_values` and :meth:`result_history_values` so the
         primary and secondary noise floors can never disagree about which
         runs count: status ok, unbanked, not resumed, not rolled-back
-        (sentinel-healed, ``n_rollbacks`` > 0) — the
+        (sentinel-healed, ``n_rollbacks`` > 0), not supervisor-recovered
+        (``supervision.n_attempts`` > 1) — the
         resume_geometry_changed check is defense in depth for a row whose
         accounting broke (flag without resumed; docs/FAULT_TOLERANCE.md)
         — not the candidate itself, and sharing the candidate's
@@ -564,6 +565,13 @@ class Registry:
             if res.get("resumed") or res.get("resume_geometry_changed"):
                 continue
             if res.get("n_rollbacks"):
+                continue
+            # Supervisor-recovered rows (runtime/supervisor.py stamps the
+            # recovery history only when recovery actually happened, i.e.
+            # n_attempts > 1): the published measurement spans a restart —
+            # recompile, possibly a geometry shrink leg — so like resumed
+            # rows it is never a clean baseline/noise-floor sample.
+            if (res.get("supervision") or {}).get("n_attempts", 1) > 1:
                 continue
             if exclude_record_id and rec.get("record_id") == exclude_record_id:
                 continue

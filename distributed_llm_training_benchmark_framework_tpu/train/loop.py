@@ -215,6 +215,14 @@ def _make_recorder(kwargs: dict) -> TelemetryRecorder:
             # reads the field off the row). Plain runs omit the key so
             # their heartbeat bytes stay unchanged.
             meta["tp_collective_matmul"] = True
+        sup_attempt = os.environ.get("BENCH_SUPERVISED_ATTEMPT", "")
+        if sup_attempt.isdigit() and int(sup_attempt) > 1:
+            # Fleet-supervisor recovery attempt: the attempt number rides
+            # run_meta and every heartbeat, so a salvaged trail from a
+            # supervised retry is attributable to its leg of the
+            # supervision.json ledger. First attempts (and unsupervised
+            # runs) omit the key — their telemetry bytes stay unchanged.
+            meta["supervised_attempt"] = int(sup_attempt)
         rec = TelemetryRecorder(
             arm,
             results_dir=kwargs.get("results_dir"),
@@ -384,17 +392,14 @@ def _run_benchmark_impl(
         hang_timeout_sec, recorder=recorder, is_main=is_main, rank=rank,
     )
     use_stream = data_path is not None
-    if use_stream and sentinel:
-        # The sentinel's heal replays steps, which on the synthetic table
-        # works by reseeding the step-index fold; a record stream would
-        # need an in-run rewind of the prefetch pipeline to replay, which
-        # no arm needs yet. Refuse loudly rather than silently running a
-        # sentinel whose rollback would corrupt the stream position.
-        raise ValueError(
-            "--sentinel on is not supported with --data-path yet: the "
-            "rollback-and-replay heal cannot rewind the record stream "
-            "mid-run; drop one of the two flags"
-        )
+    # sentinel x stream composes since the fleet-supervisor round: a
+    # rollback on the streaming path rewinds the record cursor to the
+    # restored checkpoint's stream sidecar (closed-form fallback) and
+    # rebuilds the prefetcher — see _roll_back_if_tripped. The replay
+    # re-consumes the SAME records (unlike the synthetic path's
+    # step-fold reseed): the records were never the poison — a corrupt
+    # record is healed by the stream's own CRC quarantine — the device
+    # state was, and that is what the restore replaces.
     if use_stream and data_stall_timeout_sec <= 0:
         # A non-positive timeout would classify every normal batch wait
         # as a fatal stall (or disable the classification entirely,
@@ -1100,11 +1105,50 @@ def _run_benchmark_impl(
             "timed" if rb_step + 1 >= warmup_steps else "warmup"
         )
 
+    def _rewind_stream(rb_step):
+        """Rewind the streaming input path for a rollback replay.
+
+        The restored checkpoint's ``stream_<step>.json`` sidecar is the
+        authoritative cursor (records delivered THROUGH ``rb_step``);
+        a restore without one — the in-memory-snapshot fallback, or a
+        failed sidecar write — uses the closed-form cursor, exact
+        because records_per_step is constant within a run. The old
+        prefetcher is stopped WITH a join first: its producer thread
+        advances ``stream.cursor`` as it reads ahead, and a seek issued
+        under a live producer could be overwritten by an in-flight
+        batch. Then a fresh prefetcher restarts production at
+        ``rb_step + 1`` — the replay re-consumes the same records (the
+        poison was the device state, not the stream; corrupt records
+        are the CRC quarantine's job, and a re-quarantined record
+        increments the skip ledger and its telemetry event in step).
+        """
+        nonlocal prefetch
+        prefetch.stop(join=True)
+        rewind = (
+            cursor_start + max(rb_step + 1 - start_step, 0) * records_per_step
+        )
+        if ckpt is not None and rb_step >= 0:
+            side = ckpt.read_stream_state(rb_step)
+            if side is not None:
+                rewind = int(side.get("cursor", rewind))
+        stream.seek(rewind)
+        data_meta_box[0] = None
+        prefetch = HostPrefetcher(
+            stream, sharding=batch_sharding, grad_accum=grad_accum,
+            global_micro=global_micro, seq_len=seq_len,
+            start_step=rb_step + 1, stop_step=steps,
+            injector=chaos, multi_process=jax.process_count() > 1,
+        ).start()
+        if is_main:
+            print(f"SENTINEL: stream rewound to cursor {rewind} — "
+                  f"replaying records from step {rb_step + 1}", flush=True)
+
     def _roll_back_if_tripped():
         """The whole heal for an open trip: restore + bookkeeping +
-        cursor rewind. Returns the restored ``(params, opt_state)`` (the
-        caller rebinds its locals and restarts the window clock), or
-        None when no trip is open. ONE implementation for both trip
+        cursor rewind (both the HBM cursor and, on the streaming path,
+        the record cursor). Returns the restored ``(params, opt_state)``
+        (the caller rebinds its locals and restarts the window clock),
+        or None when no trip is open. ONE implementation for both trip
         sources — the window observation and the checksum — so the two
         paths can never diverge."""
         if numerics.trip is None:
@@ -1113,6 +1157,8 @@ def _run_benchmark_impl(
         rb_params, rb_opt, rb_step = restored
         _after_rollback(rb_step, tripped_at)
         cursor.rollback(rb_step, tripped_at)
+        if prefetch is not None:
+            _rewind_stream(rb_step)
         return rb_params, rb_opt
 
     def _stream_state_for(at_step):
@@ -1361,10 +1407,20 @@ def _run_benchmark_impl(
             data_wait_win[0] += waited
             if step >= warmup_steps:
                 data_wait_timed[0] += waited
-            params, opt_state, loss = active_state.step_fn(
-                params, opt_state, stream_batch, step
-            )
-            gnorm = None
+            if sentinel_in_step:
+                # Sentinel x stream: same in-step grad-norm guard as the
+                # synthetic path, but the step index is NOT reseed-folded
+                # — a rollback replay re-consumes the same records (the
+                # stream rewind in _roll_back_if_tripped repositions the
+                # cursor), so the step index must address the same rows.
+                params, opt_state, loss, gnorm = active_state.step_fn(
+                    params, opt_state, stream_batch, step
+                )
+            else:
+                params, opt_state, loss = active_state.step_fn(
+                    params, opt_state, stream_batch, step
+                )
+                gnorm = None
         elif numerics is None:
             params, opt_state, loss = active_state.step_fn(
                 params, opt_state, table, step

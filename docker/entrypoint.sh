@@ -105,16 +105,26 @@ export HANG_TIMEOUT_SEC="${HANG_TIMEOUT_SEC:-}"
 export SENTINEL="${SENTINEL:-}"
 case "$SENTINEL" in 1) SENTINEL=on ;; 0) SENTINEL="" ;; esac
 export SENTINEL_CHECKSUM_EVERY="${SENTINEL_CHECKSUM_EVERY:-}"
-# In-pod retry loop: 0 (default) keeps the exec'd single-attempt path
-# (python as PID 1 — the preStop/terminationGrace SIGTERM contract).
-# N > 0 execs scripts/with_retries.sh as PID 1 instead — ONE retry
-# implementation for the whole repo (the former in-entrypoint loop was a
-# deliberate near-duplicate, now folded): it supervises the harness as a
-# background child with a trap-and-forward TERM handler, so kubelet's
-# grace signal still reaches the preemption handler, retries a failed
-# run up to N times with RETRY_BACKOFF_SEC backoff, resumes from
-# CHECKPOINT_DIR when one is configured, and never re-fires an injected
-# chaos fault on its own recovery attempt.
+# In-pod recovery supervision: 0/0 (default) keeps the exec'd
+# single-attempt path (python as PID 1 — the preStop/terminationGrace
+# SIGTERM contract). SUPERVISOR=1 or MAX_ARM_RETRIES > 0 execs
+# scripts/with_retries.sh as PID 1 instead, which is now a thin shim
+# into the elastic fleet supervisor (runtime/supervisor.py, docs/
+# FAULT_TOLERANCE.md) — the ONE retry implementation for the whole
+# repo: it supervises the harness as a child with a trap-and-forward
+# TERM handler (kubelet's grace signal still reaches the preemption
+# handler), classifies every exit against the EXIT_* registry, retries
+# under the recovery policy with backoff, resumes from CHECKPOINT_DIR
+# when one is configured (shrinking the geometry against the checkpoint
+# sidecar when device capacity dropped), never re-fires an injected
+# chaos fault on a recovery attempt, and writes the per-attempt
+# supervision.json ledger into RESULTS_DIR.
+#   SUPERVISOR=1        run under the supervisor even with
+#                       MAX_ARM_RETRIES=0 (policy decides the budgets)
+#   RECOVERY_POLICY     recovery-policy JSON path (empty = the legacy
+#                       MAX_ARM_RETRIES/RETRY_BACKOFF_SEC env mapping)
+export SUPERVISOR="${SUPERVISOR:-0}"
+export RECOVERY_POLICY="${RECOVERY_POLICY:-}"
 export MAX_ARM_RETRIES="${MAX_ARM_RETRIES:-0}"
 export RETRY_BACKOFF_SEC="${RETRY_BACKOFF_SEC:-5}"
 # Async delta checkpointing (docs/FAULT_TOLERANCE.md): periodic saves off
@@ -262,19 +272,24 @@ echo ""
 # stdout stream stays untouched (interposing a tee on PID 1's stdout
 # risks losing the final result markers in the teardown race), and exec
 # keeps python as PID 1.
-if [ "${MAX_ARM_RETRIES}" = "0" ]; then
+if [ "${SUPERVISOR}" = "0" ] && [ "${MAX_ARM_RETRIES}" = "0" ]; then
   exec python -u /app/benchmarking/train_harness.py ${ARGS}
 fi
 
-# Retry mode: exec scripts/with_retries.sh as PID 1 — the ONE retry
-# implementation (bounded attempts, exponential backoff, resume-not-
-# cold-restart, injected-fault stripping, and the trap-and-forward TERM
+# Supervised mode: exec scripts/with_retries.sh as PID 1 — the thin shim
+# into the elastic fleet supervisor (the ONE retry implementation:
+# exit classification, policy-driven bounded attempts with backoff,
+# resume-not-cold-restart, geometry shrink/regrow against the checkpoint
+# sidecar, injected-fault stripping, and the trap-and-forward TERM
 # handler that keeps kubelet's grace signal reaching the harness child
-# even though bash, not python, is PID 1). Resume only makes sense with
-# a checkpoint dir behind it — --resume without one is a silent no-op in
-# the harness, but passing the flag conditionally keeps retry argvs
-# byte-honest about what they can actually do.
-WRAPPER_FLAGS=(--drop-on-retry --inject-fault)
+# even though the supervisor, not the harness, is PID 1). Resume only
+# makes sense with a checkpoint dir behind it — --resume without one is
+# a silent no-op in the harness, but passing the flag conditionally
+# keeps retry argvs byte-honest about what they can actually do. The
+# supervisor reads RECOVERY_POLICY (or the MAX_ARM_RETRIES/
+# RETRY_BACKOFF_SEC legacy mapping) from the environment and drops its
+# supervision.json ledger beside the results.
+WRAPPER_FLAGS=(--drop-on-retry --inject-fault --results-dir "${RESULTS_DIR}")
 if [ -n "${CHECKPOINT_DIR}" ]; then
   WRAPPER_FLAGS+=(--resume-flag --resume)
 fi

@@ -73,12 +73,14 @@ ROOT=""
 KEEP=0
 ELASTIC=0
 K8S_CHAOS=0
+SUPERVISOR=0
 while [ $# -gt 0 ]; do
   case "$1" in
     --smoke) FAULTS="sigkill torn-checkpoint bitflip data-corrupt-record"; shift ;;
     --faults) FAULTS="$2"; shift 2 ;;
     --elastic) ELASTIC=1; shift ;;
     --k8s-chaos) K8S_CHAOS=1; shift ;;
+    --supervisor) SUPERVISOR=1; shift ;;
     --results-dir) ROOT="$2"; shift 2 ;;
     --keep) KEEP=1; shift ;;
     *) echo "chaos_suite: unknown flag $1" >&2; exit 2 ;;
@@ -86,6 +88,24 @@ while [ $# -gt 0 ]; do
 done
 [ "$ELASTIC" = "1" ] && FAULTS="$FAULTS elastic elastic-tp"
 [ "$K8S_CHAOS" = "1" ] && FAULTS="$FAULTS k8s-coordinator"
+# --supervisor (elastic-fleet-supervisor round, runtime/supervisor.py):
+#   supervisor-shrink — a dp4 arm is preempted; the supervisor's device
+#       probe (capped by the lose-host@2 chaos spec) sees only 2 chips,
+#       so it resumes the checkpoint on the largest divisor-legal
+#       geometry (dp2) through the elastic path; supervision.json must
+#       record the 4->2 shrink leg and validate_results must PASS the
+#       recovered row.
+#   supervisor-storm — repeated preemption: the injected fault stays
+#       armed through attempt 2 (preempt-storm@2), so the supervisor
+#       must spend its per-class budget attempt by attempt and still
+#       land a validated result on the third, clean, attempt.
+#   supervisor-stream-bitflip — the sentinel x stream composition: a
+#       sentinel-armed STREAMING run heals a bitflip in-process by
+#       rolling back and REWINDING the stream cursor to the validated
+#       checkpoint's sidecar, replaying the same records with no loss
+#       or duplication (records_consumed == steps, validator-checked).
+[ "$SUPERVISOR" = "1" ] && \
+  FAULTS="$FAULTS supervisor-shrink supervisor-storm supervisor-stream-bitflip"
 if [ -z "$ROOT" ]; then
   ROOT="$(mktemp -d /tmp/chaos_suite.XXXXXX)"
 else
@@ -665,6 +685,149 @@ EOF
         fail "$fault" "validate_results rejected the degraded-save run"; continue
       fi
       ok "$fault" "saves degraded with warnings; run completed and validated"
+      ;;
+    supervisor-shrink)
+      # The supervisor's headline proof: preempt a dp4 arm, cap the
+      # device probe at 2 chips from attempt 2 (lose-host@2), and the
+      # supervisor must resume the checkpoint on the largest
+      # divisor-legal geometry (dp2) through the elastic path — ledger
+      # records the 4->2 shrink leg, the recovered row carries the
+      # supervision stamp AND the elastic-resume accounting, and
+      # validate_results passes it.
+      cat > "$dir/policy.json" <<'EOF'
+{"schema_version": 1, "backoff_base_sec": 0, "backoff_max_sec": 0,
+ "jitter_frac": 0,
+ "classes": {"preempted": {"action": "resume-shrunk", "max_attempts": 3},
+             "hung": {"action": "resume", "max_attempts": 2},
+             "data_stall": {"action": "resume", "max_attempts": 2},
+             "crash": {"action": "cold-retry", "max_attempts": 1},
+             "nothing-to-resume": {"action": "give-up", "max_attempts": 0}}}
+EOF
+      env RECOVERY_POLICY="$dir/policy.json" \
+        bash scripts/with_retries.sh --resume-flag --resume \
+        --drop-on-retry --inject-fault --chaos "lose-host@2" -- \
+        python -u benchmarking/train_harness.py \
+        --strategy fsdp --world-size 4 --rank 0 --tier S --seq-len 32 \
+        --steps "$STEPS" --warmup-steps "$WARMUP" --per-device-batch 1 \
+        --grad-accum 1 --dataset-size 64 --heartbeat-sec 0 --sync-every 2 \
+        --results-dir "$dir/results" \
+        --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+        --inject-fault "sigterm@9" > "$dir/phase1.log" 2>&1
+      rc=$?
+      if [ "$rc" -ne 0 ]; then
+        fail "$fault" "supervised arm did not recover (rc=$rc, see $dir/phase1.log)"
+        continue
+      fi
+      if [ ! -f "$dir/results/supervision.json" ]; then
+        fail "$fault" "supervisor left no supervision.json ledger"; continue
+      fi
+      row="$dir/results/result_fsdp_ws2_seq32_tierS.json"
+      if [ ! -f "$row" ]; then
+        fail "$fault" "no dp2 result row after the shrink-resume"; continue
+      fi
+      if ! python - "$dir/results/supervision.json" "$row" <<'EOF'
+import json, sys
+led = json.load(open(sys.argv[1]))
+r = json.load(open(sys.argv[2]))
+assert led["shrink_legs"] == ["4->2"], f"shrink_legs={led['shrink_legs']}"
+assert led["n_attempts"] == 2, f"n_attempts={led['n_attempts']}"
+assert led["attempts"][0]["class"] == "preempted", led["attempts"][0]
+assert led["attempts"][0]["action"] == "resume-shrunk", led["attempts"][0]
+assert led["final_class"] == "ok" and not led["gave_up"], led
+assert r["world_size"] == 2, f"world_size={r['world_size']}"
+assert r["resumed"] is True and r["resume_geometry_changed"] is True, r
+assert r["supervision"]["n_attempts"] == 2, r.get("supervision")
+assert r["supervision"]["shrink_legs"] == ["4->2"], r.get("supervision")
+EOF
+      then fail "$fault" "ledger/row recovery accounting incoherent"; continue; fi
+      if ! validate "$dir"; then
+        fail "$fault" "validate_results rejected the shrink-resumed row (see $dir/validate.log)"
+        continue
+      fi
+      ok "$fault" "preempt -> probe saw 2 chips -> dp4 checkpoint resumed at dp2; ledger + row validated"
+      ;;
+    supervisor-storm)
+      # Repeated preemption: preempt-storm@2 keeps the injected SIGTERM
+      # armed through attempt 2, so the supervisor spends its preempted
+      # budget attempt by attempt (75 -> resume -> 75 -> resume) and
+      # lands a validated result on the third, clean, attempt.
+      cat > "$dir/policy.json" <<'EOF'
+{"schema_version": 1, "backoff_base_sec": 0, "backoff_max_sec": 0,
+ "jitter_frac": 0,
+ "classes": {"preempted": {"action": "resume", "max_attempts": 3},
+             "nothing-to-resume": {"action": "give-up", "max_attempts": 0}}}
+EOF
+      env RECOVERY_POLICY="$dir/policy.json" \
+        bash scripts/with_retries.sh --resume-flag --resume \
+        --drop-on-retry --inject-fault --chaos "preempt-storm@2" -- \
+        "${HARNESS[@]}" --results-dir "$dir/results" \
+        --checkpoint-dir "$dir/ckpt" --checkpoint-every "$CKPT_EVERY" \
+        --inject-fault "sigterm@9" > "$dir/phase1.log" 2>&1
+      rc=$?
+      if [ "$rc" -ne 0 ]; then
+        fail "$fault" "storm did not drain to a clean attempt (rc=$rc)"; continue
+      fi
+      if ! python - "$dir/results/supervision.json" <<'EOF'
+import json, sys
+led = json.load(open(sys.argv[1]))
+classes = [a["class"] for a in led["attempts"]]
+assert classes == ["preempted", "preempted", "ok"], classes
+# fault_kept is planning metadata: it rides the entry of the attempt
+# whose FAILURE planned the next (still-faulted) cmd — attempt 1 plans
+# the storm's attempt 2; attempt 2 plans the clean attempt 3.
+assert led["attempts"][0].get("fault_kept") is True, led["attempts"][0]
+assert led["attempts"][1].get("fault_kept") is None, led["attempts"][1]
+assert led["n_attempts"] == 3 and not led["gave_up"], led
+assert led["shrink_legs"] == [], led["shrink_legs"]
+EOF
+      then fail "$fault" "storm ledger does not show 75 -> 75 -> ok"; continue; fi
+      if ! validate "$dir"; then
+        fail "$fault" "validate_results rejected the storm-recovered row"; continue
+      fi
+      ok "$fault" "fault stayed armed 2 attempts; budgeted resumes drained the storm to a validated row"
+      ;;
+    supervisor-stream-bitflip)
+      # Sentinel x stream composition: a sentinel-armed STREAMING run
+      # takes a bitflip, rolls back in-process to the last validated
+      # checkpoint AND rewinds the stream cursor to that checkpoint's
+      # sidecar — replaying the same records, so the final ledger shows
+      # no record loss or duplication (records_consumed == steps at this
+      # 1-record/step geometry, cursor arithmetic validator-checked).
+      run_arm "$dir" "$dir/phase1.log" --data-path "$SHARDS" \
+        --sentinel on --sentinel-checksum-every "$CKPT_EVERY" \
+        --inject-fault "bitflip@9"
+      rc=$?
+      if [ "$rc" -ne 0 ]; then
+        fail "$fault" "sentinel should heal the streaming run in-process (rc=$rc)"
+        continue
+      fi
+      if ! grep -q "stream rewound to cursor" "$dir/phase1.log"; then
+        fail "$fault" "rollback did not rewind the stream cursor"; continue
+      fi
+      row="$dir/results/result_ddp_ws1_seq32_tierS.json"
+      if [ ! -f "$row" ]; then fail "$fault" "no result row"; continue; fi
+      if ! python - "$row" <<'EOF'
+import json, sys
+r = json.load(open(sys.argv[1]))
+assert r["data_mode"] == "stream", r["data_mode"]
+assert r["n_rollbacks"] == 1, f"n_rollbacks={r['n_rollbacks']}"
+assert r["rollback_steps_replayed"] >= 1, r["rollback_steps_replayed"]
+assert r["resumed"] is False, "heal must not be a restart"
+assert r["records_consumed"] == r["steps"], (
+    f"records_consumed={r['records_consumed']} != steps={r['steps']} "
+    "— the rewind lost or duplicated records")
+assert r["records_skipped"] == 0, f"records_skipped={r['records_skipped']}"
+EOF
+      then fail "$fault" "healed streaming row's cursor ledger broke"; continue; fi
+      if ! grep -aq '"event": "sentinel_trip"' "$dir/results"/telemetry_*.jsonl \
+         || ! grep -aq '"event": "rollback"' "$dir/results"/telemetry_*.jsonl; then
+        fail "$fault" "telemetry missing sentinel_trip/rollback events"; continue
+      fi
+      if ! validate "$dir"; then
+        fail "$fault" "validate_results rejected the healed streaming row (see $dir/validate.log)"
+        continue
+      fi
+      ok "$fault" "bitflip on stream healed in-process; cursor rewound exactly, no loss/duplication"
       ;;
     *)
       fail "$fault" "unknown fault class"; continue

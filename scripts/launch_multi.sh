@@ -54,6 +54,16 @@ CHECKPOINT_ASYNC="${CHECKPOINT_ASYNC:-0}"
 # HEARTBEAT_SEC, floor 120s) — enforced below — so the stack-dump abort
 # fires before kubelet's forensics-free kill.
 HANG_TIMEOUT_SEC="${HANG_TIMEOUT_SEC:-}"
+# Elastic fleet supervisor (runtime/supervisor.py, docs/
+# FAULT_TOLERANCE.md): SUPERVISOR=1 makes the entrypoint exec
+# scripts/with_retries.sh (the supervisor shim) as PID 1 — in-pod
+# classify->decide->recover with the per-attempt supervision.json
+# ledger, including geometry shrink-resume when capacity dropped.
+# RECOVERY_POLICY names a policy JSON inside the image (e.g.
+# /app/configs/recovery_policy.json); empty maps the legacy
+# MAX_ARM_RETRIES/RETRY_BACKOFF_SEC env knobs onto an equivalent policy.
+SUPERVISOR="${SUPERVISOR:-0}"
+RECOVERY_POLICY="${RECOVERY_POLICY:-}"
 # SIGTERM grace (docs/FAULT_TOLERANCE.md): kubelet preemption sends
 # SIGTERM and waits terminationGracePeriodSeconds before SIGKILL. The
 # preemption handler (train/loop.py) acts at the NEXT sync-window
@@ -99,6 +109,8 @@ while [ $# -gt 0 ]; do
     --checkpoint-every) CHECKPOINT_EVERY="$2"; shift 2 ;;
     --checkpoint-async) CHECKPOINT_ASYNC=1; shift 1 ;;
     --hang-timeout-sec) HANG_TIMEOUT_SEC="$2"; shift 2 ;;
+    --supervisor) SUPERVISOR=1; shift 1 ;;
+    --recovery-policy) RECOVERY_POLICY="$2"; shift 2 ;;
     --termination-grace-sec) TERMINATION_GRACE_SEC="$2"; shift 2 ;;
     --image) IMAGE="$2"; shift 2 ;;
     --topology) TPU_TOPOLOGY="$2"; shift 2 ;;
@@ -186,6 +198,8 @@ sed -e "s|{{JOB_NAME}}|$JOB_NAME|g" \
     -e "s|{{CHECKPOINT_EVERY}}|$CHECKPOINT_EVERY|g" \
     -e "s|{{CHECKPOINT_ASYNC}}|$CHECKPOINT_ASYNC|g" \
     -e "s|{{HANG_TIMEOUT_SEC}}|$HANG_TIMEOUT_SEC|g" \
+    -e "s|{{SUPERVISOR}}|$SUPERVISOR|g" \
+    -e "s|{{RECOVERY_POLICY}}|$RECOVERY_POLICY|g" \
     -e "s|{{LIVENESS_GRACE_SEC}}|$LIVENESS_GRACE_SEC|g" \
     -e "s|{{LIVENESS_PERIOD}}|$LIVENESS_PERIOD|g" \
     -e "s|{{TERMINATION_GRACE_SEC}}|$TERMINATION_GRACE_SEC|g" \

@@ -199,12 +199,19 @@ if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_PREFLIGHT" != "1" ]; then
 fi
 
 if [ "$SUITE_DRY_RUN" != "1" ] && [ "$SKIP_CHAOS" != "1" ]; then
-  echo "=== Chaos smoke: recovery proof (sigkill + torn-checkpoint + bitflip-heal + corrupt-record stream heal + elastic) ==="
+  echo "=== Chaos smoke: recovery proof (sigkill + torn-checkpoint + bitflip-heal + corrupt-record stream heal + elastic + supervisor) ==="
   CHAOS_DIR=$(mktemp -d /tmp/chaos_smoke.XXXXXX)
   # --elastic: the geometry-change resume proof (save@dp4 -> resume@dp2 ->
   # validate_results passes with resume_geometry_changed=true) rides the
   # same SKIP_CHAOS=1 hatch as the rest of the smoke.
-  if scripts/chaos_suite.sh --smoke --elastic --results-dir "$CHAOS_DIR"; then
+  # --supervisor: the elastic fleet supervisor's proofs ride here too —
+  # lose-host shrink-resume (preempt -> probe sees 2 chips -> dp4
+  # checkpoint resumes at dp2 with a ledgered 4->2 leg), the
+  # preempt-storm budget drain, and the sentinel x stream bitflip heal
+  # with an exactly-rewound cursor (runtime/supervisor.py,
+  # docs/FAULT_TOLERANCE.md).
+  if scripts/chaos_suite.sh --smoke --elastic --supervisor \
+       --results-dir "$CHAOS_DIR"; then
     rm -rf "$CHAOS_DIR"
   else
     echo "CHAOS SMOKE FAILED — the recovery machinery is broken, so a" \

@@ -1,126 +1,37 @@
 #!/usr/bin/env bash
-# Bounded retry wrapper for one benchmark arm (the chaos-harness
-# orchestration core, docs/FAULT_TOLERANCE.md).
+# Retry wrapper for one benchmark arm — now a THIN DELEGATION SHIM.
 #
 #   with_retries.sh [--resume-flag FLAG] [--drop-on-retry FLAG] -- cmd args...
 #
-# Runs the command; on a nonzero exit retries up to MAX_ARM_RETRIES times
-# with exponential backoff. Retries are RESUMES, not cold restarts: when
-# --resume-flag is given it is appended to the command from attempt 2 on
-# (the harness restores the newest valid checkpoint; an empty/torn
-# checkpoint dir degrades to a cold start inside the harness itself, so
-# appending unconditionally is safe). A --drop-on-retry flag (and its
-# value, when the next token is not another flag) is removed from retry
-# attempts — the hook that keeps an injected chaos fault
-# (--inject-fault sigkill@N) from re-firing on every resume; the
-# INJECT_FAULT env var is cleared on retries for the same reason.
+# The retry brain moved to the elastic fleet supervisor
+# (distributed_llm_training_benchmark_framework_tpu/runtime/supervisor.py,
+# docs/FAULT_TOLERANCE.md): exit classification against the central
+# EXIT_* registry, a declarative recovery policy (RECOVERY_POLICY=
+# configs/recovery_policy.json; without one the legacy env contract
+# below maps onto an equivalent policy), exponential backoff with
+# deterministic jitter, and — under a policy that allows it — automatic
+# geometry shrink/regrow against the checkpoint's geometry sidecar when
+# device capacity changed between attempts. This file stays ONLY as the
+# stable call-site surface; it must never grow a second retry loop.
 #
-# SIGTERM trap-and-forward (elastic-resilience round): the command runs as
-# a BACKGROUND child with a TERM trap that forwards the signal, so this
-# wrapper is safe as PID 1 — bash-as-PID-1 swallows SIGTERM for itself
-# but the harness child still receives the grace signal and its
-# preemption handler (train/loop.py) gets to emergency-checkpoint. This
-# is what lets docker/entrypoint.sh delegate its retry loop here instead
-# of keeping a near-duplicate. `wait` returns >128 when the trap fires,
-# so re-wait until the child actually exits.
+# The exec below hands PID 1 to the supervisor, which owns the SIGTERM
+# trap-and-forward contract the bash loop used to implement: the grace
+# signal is forwarded to the harness child (its preemption handler gets
+# to emergency-checkpoint) and a TERM landing between attempts exits
+# 143 immediately.
 #
-# Env contract (mirrors the SKIP_* knobs elsewhere in scripts/):
+# Env contract (unchanged — the supervisor's legacy policy mapping):
 #   MAX_ARM_RETRIES    retries after the first attempt (default 1; 0 = off)
 #   RETRY_BACKOFF_SEC  base backoff, doubled each retry (default 5)
+#   RECOVERY_POLICY    recovery-policy JSON path (optional; overrides the
+#                      two knobs above with per-class actions/budgets)
 #
 # Exit code: the final attempt's (so a run that stays broken still fails
 # the suite with its real code — including EXIT_PREEMPTED 75 when every
-# grace window was exhausted).
+# grace window was exhausted; EXIT_NOTHING_TO_RESUME 77 stays terminal).
 set -uo pipefail
 
-MAX_ARM_RETRIES="${MAX_ARM_RETRIES:-1}"
-RETRY_BACKOFF_SEC="${RETRY_BACKOFF_SEC:-5}"
-EXIT_PREEMPTED=75
-# Hang watchdog abort (faults/watchdog.py): the run wedged, dumped its
-# stacks and exited — the checkpoints on disk are intact, so this is
-# retryable-with-resume exactly like a preemption.
-EXIT_HUNG=76
-# Deterministic refusal (harness: resume found no steps left to run) —
-# never retried; every attempt would refuse identically. (Renumbered
-# 76 -> 77 in the self-healing round; 76 is now EXIT_HUNG above.)
-EXIT_NOTHING_TO_RESUME=77
-
-RESUME_FLAG=""
-DROP_ON_RETRY=""
-while [ $# -gt 0 ]; do
-  case "$1" in
-    --resume-flag) RESUME_FLAG="$2"; shift 2 ;;
-    --drop-on-retry) DROP_ON_RETRY="$2"; shift 2 ;;
-    --) shift; break ;;
-    *) echo "with_retries: unknown flag $1" >&2; exit 2 ;;
-  esac
-done
-if [ $# -eq 0 ]; then
-  echo "usage: with_retries.sh [--resume-flag FLAG] [--drop-on-retry FLAG] -- cmd args..." >&2
-  exit 2
-fi
-
-# Run one attempt with SIGTERM forwarded to the child (see header). The
-# forwarding trap stays installed only for the attempt's lifetime; a TERM
-# arriving between attempts exits the wrapper via the backoff-sleep trap
-# below — there is no child to grace.
-run_attempt() {
-  "$@" &
-  local child=$!
-  trap 'kill -TERM "$child" 2>/dev/null' TERM
-  local rc=0
-  while :; do
-    wait "$child"; rc=$?
-    kill -0 "$child" 2>/dev/null || break
-  done
-  trap - TERM
-  return "$rc"
-}
-
-attempt=0
-rc=0
-while :; do
-  attempt=$((attempt + 1))
-  if [ "$attempt" -eq 1 ]; then
-    run_attempt "$@"
-    rc=$?
-  else
-    # Rebuild the argv for a resume attempt: drop the chaos-injection
-    # flag (+ its value), clear the env fallback, append the resume flag.
-    RETRY_CMD=()
-    skip_next=0
-    for tok in "$@"; do
-      if [ "$skip_next" -eq 1 ]; then skip_next=0; continue; fi
-      if [ -n "$DROP_ON_RETRY" ] && [ "$tok" = "$DROP_ON_RETRY" ]; then
-        skip_next=1
-        continue
-      fi
-      RETRY_CMD+=("$tok")
-    done
-    if [ -n "$RESUME_FLAG" ]; then RETRY_CMD+=("$RESUME_FLAG"); fi
-    export INJECT_FAULT=""
-    run_attempt "${RETRY_CMD[@]}"
-    rc=$?
-  fi
-  [ "$rc" -eq 0 ] && exit 0
-  if [ "$rc" -eq "$EXIT_NOTHING_TO_RESUME" ] \
-     || [ "$attempt" -gt "$MAX_ARM_RETRIES" ]; then
-    exit "$rc"
-  fi
-  kind="exit=$rc"
-  [ "$rc" -eq "$EXIT_PREEMPTED" ] && kind="preempted (exit=$rc)"
-  [ "$rc" -eq "$EXIT_HUNG" ] && kind="hung (exit=$rc, watchdog abort)"
-  backoff=$((RETRY_BACKOFF_SEC * (1 << (attempt - 1))))
-  echo "with_retries: attempt $attempt failed [$kind]; retrying" \
-       "${RESUME_FLAG:+with $RESUME_FLAG }in ${backoff}s" \
-       "($((MAX_ARM_RETRIES - attempt + 1)) retr$( [ $((MAX_ARM_RETRIES - attempt + 1)) -eq 1 ] && echo y || echo ies) left)" >&2
-  # Trap TERM through the backoff too: as PID 1 (the entrypoint exec
-  # path) the kernel never delivers default-disposition signals, so a
-  # bare `sleep` would silently SWALLOW kubelet's grace signal and the
-  # pod would relaunch the harness only to be hard-killed at grace
-  # expiry. Sleep in the background so the trap fires immediately.
-  trap 'exit 143' TERM
-  sleep "$backoff" &
-  wait $! || true
-  trap - TERM
-done
+REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
+exec "${PYTHON_BIN:-python}" -u -m \
+  distributed_llm_training_benchmark_framework_tpu.runtime.supervisor "$@"

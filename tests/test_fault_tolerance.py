@@ -28,6 +28,7 @@ The legacy end-to-end SIGKILL-by-hand test stays in the slow tier.
 import errno
 import json
 import os
+import re
 import signal
 import stat
 import subprocess
@@ -513,10 +514,13 @@ exit 0
 def _with_retries(tmp_path, stub_args, wrapper_args=(), env_extra=()):
     env = dict(os.environ, MAX_ARM_RETRIES="2", RETRY_BACKOFF_SEC="0")
     env.update(dict(env_extra))
+    # cwd isolation: without --results-dir the supervisor drops its
+    # supervision.json ledger into the working directory.
     return subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "with_retries.sh"),
          *wrapper_args, "--", *stub_args],
         capture_output=True, text=True, env=env, timeout=60,
+        cwd=str(tmp_path),
     )
 
 
@@ -685,14 +689,32 @@ def test_entrypoint_plumbs_inject_fault_and_retries():
     text = open(os.path.join(REPO, "docker", "entrypoint.sh")).read()
     assert "INJECT_FAULT" in text and "--inject-fault" in text
     assert "MAX_ARM_RETRIES" in text
-    # The retry loop is FOLDED into with_retries.sh (elastic-resilience
-    # round): retry mode execs the one shared wrapper, and the SIGTERM
-    # trap-and-forward now lives THERE — bash-as-PID-1 must still deliver
-    # the grace signal to the harness child.
+    # The retry brain moved twice: first FOLDED into with_retries.sh
+    # (elastic-resilience round), then into the elastic fleet supervisor
+    # (runtime/supervisor.py) with with_retries.sh pinned as a thin exec
+    # shim — supervised mode still execs the one shared wrapper, and the
+    # SIGTERM trap-and-forward now lives in the supervisor (PID-1 python
+    # must still deliver the grace signal to the harness child).
     assert "with_retries.sh" in text
     assert "trap 'kill -TERM" not in text  # the near-duplicate is gone
+    assert "SUPERVISOR" in text and "RECOVERY_POLICY" in text
     wrapper = open(os.path.join(REPO, "scripts", "with_retries.sh")).read()
-    assert "trap 'kill -TERM" in wrapper
+    assert "runtime.supervisor" in wrapper
+    # Delegation pin: the shim must stay a shim — an exec into the
+    # supervisor module with NO second retry loop (no bash-side attempt
+    # counting, backoff arithmetic, or trap) that could drift from the
+    # policy engine.
+    assert re.search(r"^exec ", wrapper, flags=re.MULTILINE)
+    live = "\n".join(
+        line for line in wrapper.splitlines()
+        if not line.lstrip().startswith("#")
+    )
+    for relic in ("trap ", "ATTEMPT", "while ", "for ", "sleep "):
+        assert relic not in live, f"second retry loop relic: {relic!r}"
+    sup = open(os.path.join(
+        REPO, "distributed_llm_training_benchmark_framework_tpu",
+        "runtime", "supervisor.py")).read()
+    assert "SIGTERM" in sup and "signal.signal" in sup
     # Async-delta checkpointing env plumbing (GC201 keeps it honest).
     assert "CHECKPOINT_ASYNC" in text and "--checkpoint-async" in text
 

@@ -116,7 +116,7 @@ def test_lint_is_clean_on_head():
 def test_rule_catalog_is_complete():
     assert set(lint.RULES) == {
         "GC101", "GC102", "GC103", "GC104", "GC105", "GC106", "GC107",
-        "GC108", "GC109", "GC111", "GC201",
+        "GC108", "GC109", "GC111", "GC112", "GC201",
     }
     for rule in lint.RULES.values():
         assert rule.fix_hint and rule.description
@@ -852,20 +852,65 @@ def test_topology_injection_breaks_growth_law(topo_ok):
     ), growth
 
 
-def test_cli_topology_v5e64_clean(topo_ok):
+@pytest.fixture(scope="module")
+def topo_cli_freeze(topo_ok, tmp_path_factory):
+    """ONE v5e-64 CLI compile serves two acceptance tests: the clean
+    verdict (the freeze rewrites the tier from the fresh compile, so
+    byte-identical budgets ARE the exact-pin clean verdict) and the
+    freeze-only-topology no-silent-churn rule. Sharing the subprocess
+    halves the CLI topology compile cost in tier-1."""
+    import json as _json
+    import shutil
+
+    path = str(tmp_path_factory.mktemp("topo_freeze") / "budgets.json")
+    shutil.copy(hlo_audit.DEFAULT_BUDGETS_PATH, path)
+    before = _json.load(open(path))
+    proc = _cli("--topology", "v5e-64", "--update-budgets", "--lint",
+                "--budgets", path)
+    after = _json.load(open(path))
+    return proc, before, after
+
+
+def test_cli_topology_v5e64_clean(topo_cli_freeze):
     """The acceptance CLI: --topology v5e-64 compiles the roster subset
-    (>= 2 arms) AOT on the CPU host and verdicts budgets + growth laws."""
-    proc = _cli("--topology", "v5e-64")
+    (>= 2 arms) AOT on the CPU host; the refrozen tier must match the
+    committed pins exactly and break no growth law."""
+    proc, before, after = topo_cli_freeze
     assert proc.returncode == 0, proc.stderr[-3000:]
-    assert "graftcheck topology: 1 tier(s), 0 finding(s)" in proc.stderr
     assert proc.stderr.count("compiling 5 arm(s)") == 1
+    assert "froze 1 tier budget(s)" in proc.stderr
+    # The freeze path judges growth laws over the merged document and
+    # would warn by arm name; a clean head stays silent.
+    assert "WARNING (frozen anyway)" not in proc.stderr
+    # Fresh compile == committed pins (device_count, topology_name,
+    # jax_version, and every arm's counts) — the exact-pin clean verdict.
+    assert (after["topology_tiers"]["v5e-64"]
+            == before["topology_tiers"]["v5e-64"])
 
 
 def test_cli_topology_injection_exits_one(topo_ok):
-    proc = _cli("--topology", "v5e-16", "--inject", "bad-kv-spec")
+    proc = _cli("--topology", "v5e-16", "--inject", "bad-kv-spec",
+                "--arms", "llama-tp2-gqa")
     assert proc.returncode == 1, proc.stderr[-3000:]
+    assert "compiling 1 arm(s)" in proc.stderr
+    assert "graftcheck topology: 1 tier(s)," in proc.stderr
     assert "must stay 0" in proc.stderr
     assert "llama-tp2-gqa" in proc.stderr
+
+
+def test_cli_topology_unknown_arm_exits_two():
+    proc = _cli("--topology", "v5e-16", "--arms", "no-such-arm")
+    assert proc.returncode == 2
+    assert "unknown arm(s)" in proc.stderr
+    assert "no-such-arm" in proc.stderr
+
+
+def test_cli_topology_partial_freeze_refused():
+    # Freezing an --arms subset would drop the tier's other pins.
+    proc = _cli("--topology", "v5e-16", "--arms", "llama-tp2-gqa",
+                "--update-budgets")
+    assert proc.returncode == 2
+    assert "partial tier" in proc.stderr
 
 
 def test_cli_topology_unknown_tier_exits_two():
@@ -954,23 +999,16 @@ def test_commensurable_topology_tiers_filters_cross_version():
     assert set(budgets["topology_tiers"]) == {"v5e-16", "v5e-64", "v5e-256"}
 
 
-def test_topology_freeze_never_touches_roster_budgets_with_lint(tmp_path):
+def test_topology_freeze_never_touches_roster_budgets_with_lint(
+    topo_cli_freeze,
+):
     # `--topology X --update-budgets --lint` must freeze ONLY the
     # topology section: a read-only lint flag cannot flip the invocation
     # into regenerating the CPU arm budgets (the no-silent-churn rule).
-    import json as _json
-    import shutil
-
-    path = str(tmp_path / "budgets.json")
-    shutil.copy(hlo_audit.DEFAULT_BUDGETS_PATH, path)
-    before = _json.load(open(path))
-    if not hlo_audit.topology_available():
-        pytest.skip("libtpu topology tables unavailable on this host")
-    proc = _cli("--topology", "v5e-16", "--update-budgets", "--lint",
-                "--budgets", path)
+    proc, before, after = topo_cli_freeze
     assert proc.returncode == 0, proc.stderr[-3000:]
     assert "graftcheck audit:" not in proc.stderr  # roster audit never ran
-    after = _json.load(open(path))
+    assert "graftcheck lint:" in proc.stderr  # the lint leg still ran
     assert after["arms"] == before["arms"]
     assert after["jax_version"] == before["jax_version"]
 
@@ -1455,6 +1493,69 @@ def test_gc111_ignores_non_step_loops(tmp_path):
 
 def test_gc111_clean_on_head():
     assert lint.run_lint(rules=("GC111",)) == []
+
+
+# ---------------------------------------------------------------------------
+# GC112: hard-coded exit-code literals outside the central EXIT_* registry
+# ---------------------------------------------------------------------------
+
+
+def test_gc112_fires_on_literal_exit_codes_and_exempts_registry(tmp_path):
+    """A registry value (harvested from the scratch tree's own EXIT_*
+    assignments) as a bare literal in an exit call or an exit-code
+    comparison is flagged — including both members of the
+    ``rc in (75, 76)`` tuple shape; the defining assignment, named-
+    constant usage, non-registry integers, and non-exit-shaped
+    receivers are not."""
+    _scratch_root(tmp_path, "faults/codes.py", """\
+        EXIT_PREEMPTED = 75
+        EXIT_HUNG = 76
+    """)
+    root = _scratch_root(tmp_path, "runtime/scratch.py", """\
+        import sys
+
+        from ..faults.codes import EXIT_PREEMPTED
+
+        def classify(rc, percentile):
+            if rc == 75:
+                sys.exit(75)
+            if rc in (75, 76):
+                return "retryable"
+            if rc == EXIT_PREEMPTED:
+                return "named is fine"
+            if rc == 1:
+                return "not a registry value"
+            if percentile == 75:
+                return "not an exit-code receiver"
+            sys.exit(EXIT_PREEMPTED)
+    """)
+    violations = lint.run_lint(root=root, rules=("GC112",))
+    assert [v.line for v in violations] == [6, 7, 8, 8]
+    assert {v.rule_id for v in violations} == {"GC112"}
+    msgs = "\n".join(v.message for v in violations)
+    assert "EXIT_PREEMPTED" in msgs and "EXIT_HUNG" in msgs
+    assert "from ..faults import" in violations[0].fix_hint
+
+
+def test_gc112_honors_suppression(tmp_path):
+    _scratch_root(tmp_path, "faults/codes.py", """\
+        EXIT_HUNG = 76
+    """)
+    root = _scratch_root(tmp_path, "runtime/scratch.py", """\
+        def is_hang(returncode):
+            if returncode == 76:  # graftcheck: disable=GC112
+                return True
+            return returncode == 76
+    """)
+    violations = lint.run_lint(root=root, rules=("GC112",))
+    assert [v.line for v in violations] == [4]
+
+
+def test_gc112_clean_on_head():
+    """HEAD keeps every exit-code comparison on the named EXIT_*
+    constants (faults/, runtime/supervisor.py) — the registry harvest
+    sees 75/76/77/78 and nothing outside the defining assignments."""
+    assert lint.run_lint(rules=("GC112",)) == []
 
 
 # ---------------------------------------------------------------------------

@@ -663,13 +663,27 @@ def test_validator_rejects_replayed_steps_without_rollbacks():
 
 
 def test_with_retries_treats_76_as_retryable_and_77_terminal():
+    # The classification left bash in the fleet-supervisor round: the
+    # wrapper is a thin exec into runtime/supervisor.py, which imports
+    # the EXIT_* registry. Pin the delegation (no second classifier can
+    # drift in the shim) and the semantics at their new source: hung
+    # retries under the legacy env policy, nothing-to-resume never.
+    from distributed_llm_training_benchmark_framework_tpu.runtime import (
+        supervisor,
+    )
+
     text = open(os.path.join(REPO, "scripts", "with_retries.sh")).read()
-    assert "EXIT_HUNG=76" in text
-    assert "EXIT_NOTHING_TO_RESUME=77" in text
-    # The never-retry branch keys on NOTHING_TO_RESUME only — EXIT_HUNG
-    # must fall through to the retry path.
-    assert '"$EXIT_HUNG"' not in text.split("EXIT_NOTHING_TO_RESUME\"")[0] \
-        or "hung (exit=$rc" in text
+    assert "runtime.supervisor" in text
+    assert "EXIT_HUNG=" not in text
+    assert supervisor.classify_exit(faults.EXIT_HUNG) == "hung"
+    policy = supervisor.validate_policy(
+        supervisor.default_policy_from_env({})
+    )
+    assert policy["classes"]["hung"]["max_attempts"] >= 1
+    action, _ = supervisor.Supervisor(["true"], policy=policy).decide(
+        "nothing-to-resume"
+    )
+    assert action == "give-up"
 
 
 def test_with_retries_resumes_after_hung_exit(tmp_path):
@@ -690,6 +704,7 @@ def test_with_retries_resumes_after_hung_exit(tmp_path):
          "--resume-flag", "--resume", "--", str(stub)],
         capture_output=True, text=True, timeout=120,
         env=dict(os.environ, MAX_ARM_RETRIES="1", RETRY_BACKOFF_SEC="0"),
+        cwd=str(tmp_path),  # the ledger lands in cwd without --results-dir
     )
     assert p.returncode == 0, p.stdout + p.stderr
     assert "hung (exit=76" in p.stderr
@@ -833,3 +848,53 @@ def test_opt_moments_trips_grad_norm_guard_first_and_heals(tmp_path):
     assert trips[0]["step"] == 10
     fault = [e for e in events if e["event"] == "fault_injected"]
     assert fault and "opt-moments" in fault[0]["fault"]
+
+
+def test_sentinel_heals_on_stream_with_exact_record_replay(tmp_path):
+    """sentinel x stream composes (fleet-supervisor round): the rollback
+    rewinds the RECORD cursor to the restored checkpoint's stream
+    sidecar and replays the same records — the refusal that used to
+    guard this composition is gone. The exactness proof is the ledger:
+    records_consumed == steps * records_per_step with zero skips, i.e.
+    the replay neither lost nor double-consumed a record (the validator
+    cross-checks the cursor arithmetic)."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from make_tokenized_shards import make_shards
+    from distributed_llm_training_benchmark_framework_tpu.parallel import (
+        get_strategy,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.train.loop import (
+        run_benchmark,
+    )
+    from distributed_llm_training_benchmark_framework_tpu.analysis import (
+        validate_results as vr,
+    )
+
+    shards = tmp_path / "shards"
+    make_shards(str(shards), num_shards=4, records_per_shard=16,
+                seq_len=32, vocab_size=512, seed=42)
+    result = run_benchmark(
+        strategy=get_strategy("ddp"), tier="S", seq_len=32, steps=14,
+        warmup_steps=2, per_device_batch=1, grad_accum=1, world_size=1,
+        results_dir=str(tmp_path / "results"),
+        checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=4,
+        sync_every=2, sentinel=True, sentinel_checksum_every=4,
+        data_path=str(shards),
+        inject_fault="bitflip@9", telemetry=True, heartbeat_sec=0,
+    )
+    assert result.n_rollbacks == 1
+    assert result.rollback_steps_replayed >= 1
+    row = json.load(open(tmp_path / "results" / f"result_{ARM}.json"))
+    assert row["data_mode"] == "stream"
+    # 1 record/step (pdb 1, ga 1, ws 1): a lost or double-consumed record
+    # would show up here — and in the validator's cursor arithmetic.
+    assert row["records_consumed"] == 14
+    assert row["records_skipped"] == 0
+    assert row["stream_cursor_end"] - row["stream_cursor_start"] == 14
+    events = [json.loads(l) for l in
+              open(tmp_path / "results" / f"telemetry_{ARM}.jsonl")]
+    assert [e for e in events if e["event"] == "sentinel_trip"]
+    rbs = [e for e in events if e["event"] == "rollback"]
+    assert len(rbs) == 1 and rbs[0]["to_step"] >= 0  # checkpoint restore
+    failures = vr.validate_result(row, "stream-healed")
+    assert failures == [], failures

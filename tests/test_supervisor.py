@@ -1,0 +1,600 @@
+"""Elastic fleet supervisor: the classify -> decide -> recover contract.
+
+Tier-1 pins for ``runtime/supervisor.py`` (docs/FAULT_TOLERANCE.md):
+
+- classification: every child exit code maps onto the EXIT_* registry
+  (signal deaths included) — no integer literals, per graftcheck GC112;
+- policy: the declarative schema's loud refusals, the legacy
+  MAX_ARM_RETRIES/RETRY_BACKOFF_SEC env mapping, and per-class budget
+  exhaustion via ``decide``;
+- backoff: exponential with DETERMINISTIC jitter — same token, same
+  timeline (chaos runs assert on the ledger, so the retry schedule is
+  part of a run's identity);
+- geometry planning: shrink to the largest divisor-legal data degree,
+  regrow when capacity returns, refuse when even the fixed model
+  footprint does not fit;
+- the ledger schema (frozen in
+  tests/fixtures/supervision_ledger_frozen.json) and the result-row
+  supervision stamp;
+- stub-child loops: resume + fault scrub, cold-retry, give-up paths,
+  driven through ``Supervisor.run()`` with a real subprocess stub;
+- the acceptance proof: a REAL harness preempted mid-run under
+  ``--chaos lose-host@2``, resumed by the supervisor at the shrunken
+  divisor-legal geometry (dp4 -> dp2), finishing with a validated row
+  stamped with its recovery history.
+"""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+
+from distributed_llm_training_benchmark_framework_tpu import faults  # noqa: E402
+from distributed_llm_training_benchmark_framework_tpu.runtime import (  # noqa: E402
+    supervisor as sup,
+)
+from distributed_llm_training_benchmark_framework_tpu.analysis import (  # noqa: E402
+    validate_results as vr,
+)
+
+
+# ---------------------------------------------------------------------------
+# Classification
+# ---------------------------------------------------------------------------
+
+
+def test_classify_exit_matrix():
+    assert sup.classify_exit(0) == "ok"
+    assert sup.classify_exit(faults.EXIT_PREEMPTED) == "preempted"
+    assert sup.classify_exit(faults.EXIT_HUNG) == "hung"
+    assert sup.classify_exit(faults.EXIT_NOTHING_TO_RESUME) == (
+        "nothing-to-resume"
+    )
+    assert sup.classify_exit(faults.EXIT_DATA_STALL) == "data_stall"
+    assert sup.classify_exit(1) == "crash"
+    assert sup.classify_exit(137) == "crash"  # SIGKILL via shell convention
+    assert sup.classify_exit(-9) == "crash"   # raw subprocess signal death
+
+
+# ---------------------------------------------------------------------------
+# Policy schema
+# ---------------------------------------------------------------------------
+
+
+def _policy(**overrides):
+    p = {
+        "schema_version": 1,
+        "backoff_base_sec": 0.0,
+        "backoff_max_sec": 0.0,
+        "jitter_frac": 0.0,
+        "classes": {
+            "preempted": {"action": "resume", "max_attempts": 2},
+        },
+    }
+    p.update(overrides)
+    return p
+
+
+def test_validate_policy_defaults_and_pass_through():
+    p = sup.validate_policy(
+        {"schema_version": 1,
+         "classes": {"crash": {"action": "cold-retry", "max_attempts": 1}}}
+    )
+    assert p["backoff_base_sec"] == 5.0
+    assert p["backoff_max_sec"] == sup.BACKOFF_CAP_SEC
+    assert p["jitter_frac"] == 0.1
+
+
+@pytest.mark.parametrize("mutate, needle", [
+    (lambda p: p.update(schema_version=2), "schema_version"),
+    (lambda p: p.update(classes={}), "classes"),
+    (lambda p: p.update(classes={"bogus": {"action": "resume"}}),
+     "unknown exit class"),
+    (lambda p: p.update(classes={"ok": {"action": "resume"}}),
+     "unknown exit class"),
+    (lambda p: p.update(
+        classes={"hung": {"action": "reboot", "max_attempts": 1}}),
+     "not one of"),
+    (lambda p: p.update(
+        classes={"hung": {"action": "resume", "max_attempts": -1}}),
+     "non-negative"),
+    (lambda p: p.update(
+        classes={"hung": {"action": "resume", "max_attempts": 1.5}}),
+     "non-negative"),
+    (lambda p: p.update(jitter_frac=-0.1), "jitter_frac"),
+])
+def test_validate_policy_refuses_loudly(mutate, needle):
+    p = _policy()
+    mutate(p)
+    with pytest.raises(sup.PolicyError, match=needle):
+        sup.validate_policy(p)
+
+
+def test_default_policy_from_env_maps_legacy_retry_contract():
+    p = sup.default_policy_from_env(
+        {"MAX_ARM_RETRIES": "3", "RETRY_BACKOFF_SEC": "2"}
+    )
+    p = sup.validate_policy(p)
+    for c in ("preempted", "hung", "data_stall", "crash"):
+        assert p["classes"][c] == {"action": "resume", "max_attempts": 3}
+    assert p["classes"]["nothing-to-resume"] == {
+        "action": "give-up", "max_attempts": 0,
+    }
+    assert p["backoff_base_sec"] == 2.0
+    assert p["jitter_frac"] == 0.0  # byte-for-byte the old wrapper timeline
+    # Bare env -> the wrapper's documented defaults.
+    d = sup.default_policy_from_env({})
+    assert d["classes"]["crash"]["max_attempts"] == 1
+    assert d["backoff_base_sec"] == 5.0
+
+
+def test_load_policy_sources(tmp_path):
+    policy, source = sup.load_policy(None)
+    assert source == "env"
+    path = tmp_path / "policy.json"
+    path.write_text(json.dumps(_policy()))
+    policy, source = sup.load_policy(str(path))
+    assert source == f"file:{path}"
+    assert policy["classes"]["preempted"]["action"] == "resume"
+
+
+def test_shipped_recovery_policy_validates():
+    with open(os.path.join(REPO, "configs", "recovery_policy.json")) as f:
+        policy = sup.validate_policy(json.load(f))
+    assert policy["classes"]["preempted"]["action"] == "resume-shrunk"
+    assert policy["classes"]["nothing-to-resume"]["action"] == "give-up"
+
+
+# ---------------------------------------------------------------------------
+# Backoff determinism
+# ---------------------------------------------------------------------------
+
+
+def test_backoff_doubles_and_caps():
+    p = {"backoff_base_sec": 2.0, "backoff_max_sec": 9.0, "jitter_frac": 0.0}
+    waits = [sup.backoff_sec(p, n_recoveries=n, token="arm|1")
+             for n in range(4)]
+    assert waits == [2.0, 4.0, 8.0, 9.0]  # 16 -> capped at 9
+
+
+def test_backoff_jitter_is_deterministic_and_bounded():
+    p = {"backoff_base_sec": 4.0, "backoff_max_sec": 600.0,
+         "jitter_frac": 0.25}
+    a = sup.backoff_sec(p, n_recoveries=0, token="arm|2")
+    b = sup.backoff_sec(p, n_recoveries=0, token="arm|2")
+    c = sup.backoff_sec(p, n_recoveries=0, token="arm|3")
+    assert a == b                      # same token -> same timeline
+    assert a != c                      # attempt number perturbs the jitter
+    assert 4.0 <= a < 4.0 * 1.25 + 1e-9
+    assert sup.backoff_sec(p, n_recoveries=0, token="x") >= 4.0
+
+
+# ---------------------------------------------------------------------------
+# Geometry planning
+# ---------------------------------------------------------------------------
+
+
+def test_plan_world_size_matrix():
+    plan = sup.plan_world_size
+    # No probe information: hold the current geometry.
+    assert plan(saved_axes={"data": 4}, available=None,
+                original_world=4, current_world=2) == 2
+    # Capacity back at (or above) the original: regrow.
+    assert plan(saved_axes={"data": 4}, available=8,
+                original_world=4, current_world=2) == 4
+    # dp4 with 3 devices: largest divisor of 4 that fits is 2.
+    assert plan(saved_axes={"data": 4}, available=3,
+                original_world=4, current_world=4) == 2
+    # dp4 x tp2 (fixed=2) with 5 devices: dp_cap=2 -> world 4.
+    assert plan(saved_axes={"data": 4, "model": 2}, available=5,
+                original_world=8, current_world=8) == 4
+    # dp3 with 2 devices: divisors of 3 are {1, 3}; only dp1 fits.
+    assert plan(saved_axes={"data": 3}, available=2,
+                original_world=3, current_world=3) == 1
+    # Pure tp4: the model footprint is a hard floor -> no legal geometry.
+    assert plan(saved_axes={"model": 4}, available=2,
+                original_world=4, current_world=4) is None
+
+
+def test_read_saved_geometry_picks_newest_and_refuses_garbage(tmp_path):
+    assert sup.read_saved_geometry(str(tmp_path)) is None
+    (tmp_path / "geometry_4.json").write_text(
+        json.dumps({"schema_version": 1, "mesh_axes": {"data": 4},
+                    "world_size": 4})
+    )
+    (tmp_path / "geometry_8.json").write_text(
+        json.dumps({"schema_version": 1, "mesh_axes": {"data": 2},
+                    "world_size": 2})
+    )
+    geom = sup.read_saved_geometry(str(tmp_path))
+    assert geom["mesh_axes"] == {"data": 2}  # newest step wins
+    # A NEWER schema or a malformed payload is refused, not guessed at.
+    (tmp_path / "geometry_9.json").write_text(
+        json.dumps({"schema_version": 99, "mesh_axes": {"data": 2}})
+    )
+    assert sup.read_saved_geometry(str(tmp_path)) is None
+    (tmp_path / "geometry_9.json").write_text("{not json")
+    assert sup.read_saved_geometry(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# Chaos grammar + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_parse_supervisor_chaos_grammar():
+    c = sup.parse_supervisor_chaos(["lose-host@2"])
+    assert c == {"lose_host_at": 2, "lose_host_devices": None}
+    c = sup.parse_supervisor_chaos(["lose-host@2:3", "regain-host@4"])
+    assert c["lose_host_devices"] == 3 and c["regain_host_at"] == 4
+    assert sup.parse_supervisor_chaos(["preempt-storm@2"]) == {
+        "preempt_storm_until": 2,
+    }
+    assert sup.parse_supervisor_chaos(["", ""]) == {}
+    with pytest.raises(ValueError, match="unknown supervisor chaos kind"):
+        sup.parse_supervisor_chaos(["meteor@2"])
+    with pytest.raises(ValueError, match="attempt number"):
+        sup.parse_supervisor_chaos(["lose-host@soon"])
+    with pytest.raises(ValueError, match=">= 1"):
+        sup.parse_supervisor_chaos(["lose-host@0"])
+    with pytest.raises(ValueError, match="takes no arg"):
+        sup.parse_supervisor_chaos(["preempt-storm@2:9"])
+
+
+def test_parse_cli_accepts_flag_shaped_values():
+    # The canonical with_retries.sh call: values ARE flags; argparse's
+    # option lookahead chokes on this — the hand-rolled parser must not.
+    opts, cmd = sup.parse_cli(
+        ["--resume-flag", "--resume", "--drop-on-retry", "--inject-fault",
+         "--chaos", "lose-host@2", "--chaos=preempt-storm@2",
+         "--results-dir", "/r", "--", "python", "-u", "h.py"]
+    )
+    assert opts["resume_flag"] == "--resume"
+    assert opts["drop_on_retry"] == "--inject-fault"
+    assert opts["chaos"] == ["lose-host@2", "preempt-storm@2"]
+    assert opts["results_dir"] == "/r"
+    assert cmd == ["python", "-u", "h.py"]
+
+
+@pytest.mark.parametrize("argv, needle", [
+    (["--policy"], "needs a value"),
+    (["--frobnicate", "x", "--", "cmd"], "unknown flag"),
+    (["--results-dir", "/r"], "missing -- separator"),
+    (["--results-dir", "/r", "--"], "no command after"),
+])
+def test_parse_cli_refuses_malformed_calls(argv, needle):
+    with pytest.raises(ValueError, match=needle):
+        sup.parse_cli(argv)
+
+
+def test_cli_usage_error_exit(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m",
+         "distributed_llm_training_benchmark_framework_tpu.runtime."
+         "supervisor", "--no-such-flag"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 2
+    assert "usage:" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# decide(): the pure policy half
+# ---------------------------------------------------------------------------
+
+
+def _supervisor(policy=None, cmd=("true",), **kw):
+    return sup.Supervisor(
+        list(cmd), policy=sup.validate_policy(policy or _policy()), **kw
+    )
+
+
+def test_decide_follows_policy_then_exhausts_budget():
+    s = _supervisor()
+    action, reason = s.decide("preempted")
+    assert action == "resume" and "policy" in reason
+    s.spent["preempted"] = 2  # budget is max_attempts=2
+    action, reason = s.decide("preempted")
+    assert action == "give-up" and "budget exhausted" in reason
+
+
+def test_decide_gives_up_without_a_policy_entry():
+    action, reason = _supervisor().decide("crash")
+    assert action == "give-up" and "no policy entry" in reason
+
+
+def test_decide_never_retries_a_deterministic_refusal():
+    p = _policy()
+    p["classes"]["nothing-to-resume"] = {
+        "action": "resume", "max_attempts": 5,  # policy says retry...
+    }
+    action, reason = _supervisor(policy=p).decide("nothing-to-resume")
+    assert action == "give-up"  # ...the supervisor knows better
+    assert "deterministic refusal" in reason
+
+
+# ---------------------------------------------------------------------------
+# Stub-child loops (real subprocesses, no harness)
+# ---------------------------------------------------------------------------
+
+
+def _write_stub(tmp_path, fail_times, rc=None):
+    """A child that fails ``fail_times`` times with ``rc`` (default:
+    EXIT_PREEMPTED), then publishes a result row and succeeds — the
+    argv/env logs are the observable recovery surgery."""
+    rc = faults.EXIT_PREEMPTED if rc is None else rc
+    stub = tmp_path / "stub.sh"
+    stub.write_text(f"""#!/usr/bin/env bash
+echo "$@" >> {tmp_path}/argv.log
+echo "INJECT_FAULT=${{INJECT_FAULT-unset}}" >> {tmp_path}/env.log
+echo "ATTEMPT=${{BENCH_SUPERVISED_ATTEMPT:-}}" >> {tmp_path}/attempt.log
+n=$(cat {tmp_path}/count 2>/dev/null || echo 0)
+n=$((n+1)); echo $n > {tmp_path}/count
+if [ "$n" -le {fail_times} ]; then exit {rc}; fi
+printf '{{"arm": "stub", "world_size": 1}}\\n' > {tmp_path}/result_stub.json
+exit 0
+""")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    return stub
+
+
+def test_run_resumes_scrubs_fault_and_stamps_row(tmp_path):
+    stub = _write_stub(tmp_path, fail_times=1)
+    s = _supervisor(
+        cmd=[str(stub), "--steps", "5", "--inject-fault", "sigterm@3",
+             "--results-dir", str(tmp_path)],
+        resume_flag="--resume", drop_on_retry="--inject-fault",
+    )
+    assert s.results_dir == str(tmp_path)  # introspected from child argv
+    assert s.run() == 0
+    argv = (tmp_path / "argv.log").read_text().splitlines()
+    assert argv == [
+        "--steps 5 --inject-fault sigterm@3 --results-dir " + str(tmp_path),
+        "--steps 5 --results-dir " + str(tmp_path) + " --resume",
+    ]
+    env_lines = (tmp_path / "env.log").read_text().splitlines()
+    assert env_lines[0] == "INJECT_FAULT=unset"
+    assert env_lines[1] == "INJECT_FAULT="  # env fallback scrubbed too
+    attempts = (tmp_path / "attempt.log").read_text().splitlines()
+    assert attempts == ["ATTEMPT=1", "ATTEMPT=2"]
+    # The recovered row carries its recovery history.
+    row = json.load(open(tmp_path / "result_stub.json"))
+    assert row["supervision"]["n_attempts"] == 2
+    assert row["supervision"]["classes"] == ["preempted", "ok"]
+    assert row["supervision"]["actions"] == ["resume"]
+    assert row["supervision"]["gave_up"] is False
+
+
+def test_run_ledger_matches_frozen_schema(tmp_path):
+    frozen = json.load(
+        open(os.path.join(FIXTURES, "supervision_ledger_frozen.json"))
+    )
+    stub = _write_stub(tmp_path, fail_times=1)
+    s = _supervisor(
+        cmd=[str(stub), "--results-dir", str(tmp_path)],
+        resume_flag="--resume",
+    )
+    assert s.run() == 0
+    ledger = json.load(open(tmp_path / "supervision.json"))
+    assert ledger["schema_version"] == frozen["schema_version"]
+    assert sorted(ledger) == sorted(frozen["ledger_keys"])
+    base = set(frozen["attempt_keys"])
+    optional = set(frozen["optional_attempt_keys"])
+    for attempt in ledger["attempts"]:
+        assert base <= set(attempt), attempt
+        assert set(attempt) - base <= optional, attempt
+    summary = sup.supervision_summary(ledger)
+    assert sorted(summary) == sorted(frozen["summary_keys"])
+
+
+def test_run_exhausts_budget_and_returns_true_code(tmp_path):
+    stub = _write_stub(tmp_path, fail_times=99, rc=faults.EXIT_HUNG)
+    p = _policy(classes={"hung": {"action": "resume", "max_attempts": 2}})
+    s = _supervisor(policy=p, cmd=[str(stub), "--results-dir",
+                                   str(tmp_path)])
+    assert s.run() == faults.EXIT_HUNG  # the child's REAL code, not 1
+    ledger = json.load(open(tmp_path / "supervision.json"))
+    assert ledger["n_attempts"] == 3  # 1 + the 2 budgeted recoveries
+    assert ledger["gave_up"] is True
+    assert ledger["final_class"] == "hung"
+    assert "budget exhausted" in ledger["attempts"][-1]["give_up_reason"]
+
+
+def test_run_gives_up_immediately_on_nothing_to_resume(tmp_path):
+    stub = _write_stub(
+        tmp_path, fail_times=99, rc=faults.EXIT_NOTHING_TO_RESUME
+    )
+    s = _supervisor(cmd=[str(stub), "--results-dir", str(tmp_path)])
+    assert s.run() == faults.EXIT_NOTHING_TO_RESUME
+    ledger = json.load(open(tmp_path / "supervision.json"))
+    assert ledger["n_attempts"] == 1  # zero backoff burned
+    assert "deterministic refusal" in (
+        ledger["attempts"][0]["give_up_reason"]
+    )
+
+
+def test_run_cold_retry_restarts_without_resume_flag(tmp_path):
+    stub = _write_stub(tmp_path, fail_times=1, rc=1)
+    p = _policy(
+        classes={"crash": {"action": "cold-retry", "max_attempts": 1}}
+    )
+    s = _supervisor(
+        policy=p,
+        cmd=[str(stub), "--inject-fault", "sigterm@3",
+             "--results-dir", str(tmp_path)],
+        resume_flag="--resume", drop_on_retry="--inject-fault",
+    )
+    assert s.run() == 0
+    argv = (tmp_path / "argv.log").read_text().splitlines()
+    assert "--resume" not in argv[1]          # cold restart, not a resume
+    assert "--inject-fault" not in argv[1]    # fault still scrubbed
+
+
+def test_run_preempt_storm_keeps_fault_armed(tmp_path):
+    stub = _write_stub(tmp_path, fail_times=2)
+    p = _policy(
+        classes={"preempted": {"action": "resume", "max_attempts": 3}}
+    )
+    s = _supervisor(
+        policy=p,
+        cmd=[str(stub), "--inject-fault", "sigterm@3",
+             "--results-dir", str(tmp_path)],
+        resume_flag="--resume", drop_on_retry="--inject-fault",
+        chaos=sup.parse_supervisor_chaos(["preempt-storm@2"]),
+    )
+    assert s.run() == 0
+    argv = (tmp_path / "argv.log").read_text().splitlines()
+    assert "--inject-fault" in argv[1]        # armed through attempt 2
+    assert "--inject-fault" not in argv[2]    # scrubbed after the storm
+    ledger = json.load(open(tmp_path / "supervision.json"))
+    # fault_kept rides the entry of the attempt whose FAILURE planned the
+    # next cmd: attempt 1 planned the still-armed attempt 2.
+    assert ledger["attempts"][0].get("fault_kept") is True
+    assert ledger["attempts"][1].get("fault_kept") is None
+
+
+def test_run_backoff_uses_injected_sleep_deterministically(tmp_path):
+    stub = _write_stub(tmp_path, fail_times=2)
+    p = _policy(
+        backoff_base_sec=2.0, backoff_max_sec=600.0, jitter_frac=0.0,
+        classes={"preempted": {"action": "resume", "max_attempts": 3}},
+    )
+    sleeps = []
+    s = _supervisor(
+        policy=p, cmd=[str(stub), "--results-dir", str(tmp_path)],
+        resume_flag="--resume", sleep=sleeps.append,
+    )
+    assert s.run() == 0
+    assert sleeps == [2.0, 4.0]  # exponential, per-class recovery count
+    ledger = json.load(open(tmp_path / "supervision.json"))
+    assert [a["backoff_sec"] for a in ledger["attempts"]] == [2.0, 4.0, 0.0]
+
+
+def test_stamp_result_row_only_touches_rows_from_this_run(tmp_path):
+    stale = tmp_path / "result_old.json"
+    stale.write_text('{"arm": "old"}')
+    past = time.time() - 3600
+    os.utime(stale, (past, past))
+    assert sup.stamp_result_row(
+        str(tmp_path), time.time(), {"n_attempts": 2}
+    ) is None  # a pre-existing row is NOT claimed
+    fresh = tmp_path / "result_new.json"
+    fresh.write_text('{"arm": "new"}')
+    stamped = sup.stamp_result_row(
+        str(tmp_path), past, {"n_attempts": 2}
+    )
+    assert stamped == str(fresh)
+    assert json.load(open(fresh))["supervision"] == {"n_attempts": 2}
+    assert "supervision" not in json.load(open(stale))
+
+
+# ---------------------------------------------------------------------------
+# The acceptance proof: preempt -> shrink -> resume, real harness
+# ---------------------------------------------------------------------------
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("INJECT_FAULT", None)
+    env.pop("SUPERVISOR_CHAOS", None)
+    env.pop("RECOVERY_POLICY", None)
+    return env
+
+
+SHRUNK_ARM = "fsdp_ws2_seq32_tierS"
+
+
+@pytest.fixture(scope="module")
+def shrink_round_trip(tmp_path_factory):
+    """fsdp dp4 preempted at step 9; ``lose-host@2`` caps the probe at 2
+    devices, so the supervisor resumes the dp4 checkpoint at dp2."""
+    base = tmp_path_factory.mktemp("supervisor_shrink")
+    results, ckpt = base / "results", base / "ckpt"
+    policy = base / "policy.json"
+    policy.write_text(json.dumps({
+        "schema_version": 1,
+        "backoff_base_sec": 0.0, "backoff_max_sec": 0.0, "jitter_frac": 0.0,
+        "classes": {
+            "preempted": {"action": "resume-shrunk", "max_attempts": 3},
+            "hung": {"action": "resume", "max_attempts": 2},
+            "data_stall": {"action": "resume", "max_attempts": 2},
+            "crash": {"action": "cold-retry", "max_attempts": 1},
+            "nothing-to-resume": {"action": "give-up", "max_attempts": 0},
+        },
+    }))
+    proc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "distributed_llm_training_benchmark_framework_tpu.runtime."
+            "supervisor",
+            "--policy", str(policy),
+            "--resume-flag", "--resume",
+            "--drop-on-retry", "--inject-fault",
+            "--results-dir", str(results),
+            "--chaos", "lose-host@2",
+            "--",
+            sys.executable, "-u",
+            os.path.join(REPO, "benchmarking", "train_harness.py"),
+            "--strategy", "fsdp", "--world-size", "4", "--rank", "0",
+            "--tier", "S", "--seq-len", "32", "--steps", "14",
+            "--warmup-steps", "2", "--per-device-batch", "1",
+            "--grad-accum", "1", "--dataset-size", "64",
+            "--sync-every", "2", "--heartbeat-sec", "0",
+            "--results-dir", str(results),
+            "--checkpoint-dir", str(ckpt), "--checkpoint-every", "4",
+            "--inject-fault", "sigterm@9",
+        ],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=540,
+    )
+    return {"base": base, "results": results, "proc": proc}
+
+
+def test_shrink_round_trip_succeeds(shrink_round_trip):
+    proc = shrink_round_trip["proc"]
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "geometry leg 4->2" in proc.stderr
+
+
+def test_shrink_round_trip_ledger(shrink_round_trip):
+    ledger = json.load(
+        open(shrink_round_trip["results"] / "supervision.json")
+    )
+    assert ledger["n_attempts"] == 2
+    assert ledger["final_class"] == "ok"
+    assert ledger["gave_up"] is False
+    assert ledger["shrink_legs"] == ["4->2"]
+    first, second = ledger["attempts"]
+    assert first["class"] == "preempted"
+    assert first["action"] == "resume-shrunk"
+    assert first["rc"] == faults.EXIT_PREEMPTED
+    assert first["devices_available"] == 2
+    assert first["shrink_leg"] == "4->2"
+    assert second["class"] == "ok" and second["rc"] == 0
+    cmd2 = " ".join(second["cmd"])
+    assert "--world-size 2" in cmd2 and "--resume" in cmd2
+    assert "--inject-fault" not in cmd2
+
+
+def test_shrink_round_trip_row_is_stamped_and_valid(shrink_round_trip):
+    results = shrink_round_trip["results"]
+    path = results / f"result_{SHRUNK_ARM}.json"
+    row = json.load(open(path))
+    assert row["world_size"] == 2
+    assert row["resumed"] is True
+    assert row["resume_geometry_changed"] is True
+    assert row["supervision"]["n_attempts"] == 2
+    assert row["supervision"]["shrink_legs"] == ["4->2"]
+    assert row["supervision"]["actions"] == ["resume-shrunk"]
+    failures = vr.validate_result(row, "shrunk-row")
+    failures += vr.validate_telemetry(str(path), row, "shrunk-row")
+    assert failures == [], failures
